@@ -1,0 +1,17 @@
+"""cruise_control_tpu — a TPU-native cluster-rebalancing framework.
+
+A from-scratch JAX/XLA re-design of the capabilities of LinkedIn Cruise Control
+(reference: /root/reference): windowed load monitoring, a goal-priority rebalance
+optimizer, anomaly detection with self-healing, a throttled proposal executor and
+an async REST API.
+
+Unlike the reference's mutable object graph + per-action greedy loop
+(cc/model/ClusterModel.java, cc/analyzer/goals/AbstractGoal.java), the cluster
+workload model here is a flat pytree of device arrays and each hard/soft goal is
+a vectorized violation/cost kernel; candidate actions are scored in parallel with
+`vmap` and reduced across chips with `psum`.
+"""
+
+__version__ = "0.1.0"
+
+from cruise_control_tpu.common.resources import Resource  # noqa: F401

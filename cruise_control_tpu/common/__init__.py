@@ -1,0 +1,9 @@
+from cruise_control_tpu.common.resources import (  # noqa: F401
+    NUM_PART_METRICS,
+    NUM_RESOURCES,
+    ActionAcceptance,
+    ActionType,
+    BrokerState,
+    PartMetric,
+    Resource,
+)

@@ -1,0 +1,213 @@
+"""The framework's main configuration.
+
+Re-creates the reference's `KafkaCruiseControlConfig`
+(cc/config/KafkaCruiseControlConfig.java, 100 keys) with the same key names and
+defaults for everything this framework supports, so an operator's
+cruisecontrol.properties carries over. Goal class names accept both the
+reference's Java class paths (mapped onto our goal registry by simple name) and
+native `cruise_control_tpu...` paths.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Mapping
+
+from cruise_control_tpu.config.configdef import (
+    AbstractConfig,
+    ConfigDef,
+    Importance,
+    Type,
+    at_least,
+    between,
+    load_properties,
+)
+
+# Default goal stack, same order as the reference's default.goals
+# (config/cruisecontrol.properties, cc/config/KafkaCruiseControlConfig.java:1287-1322).
+DEFAULT_GOALS = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+    "DiskUsageDistributionGoal",
+    "NetworkInboundUsageDistributionGoal",
+    "NetworkOutboundUsageDistributionGoal",
+    "CpuUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "LeaderBytesInDistributionGoal",
+]
+
+HARD_GOALS = [
+    "RackAwareGoal",
+    "ReplicaCapacityGoal",
+    "DiskCapacityGoal",
+    "NetworkInboundCapacityGoal",
+    "NetworkOutboundCapacityGoal",
+    "CpuCapacityGoal",
+]
+
+ANOMALY_DETECTION_GOALS = HARD_GOALS
+
+
+def _config_def() -> ConfigDef:
+    d = ConfigDef()
+    # --- analyzer thresholds (reference defaults at KafkaCruiseControlConfig.java:1100-1250)
+    for res in ("cpu", "disk", "network.inbound", "network.outbound"):
+        d.define(f"{res}.balance.threshold", Type.DOUBLE, 1.10, at_least(1.0), Importance.HIGH,
+                 f"Upper/lower margin around the average {res} utilization that counts as balanced.")
+        d.define(f"{res}.capacity.threshold", Type.DOUBLE, 0.80, between(0.0, 1.0), Importance.HIGH,
+                 f"Maximum fraction of {res} capacity usable before the capacity goal acts.")
+        d.define(f"{res}.low.utilization.threshold", Type.DOUBLE, 0.0, between(0.0, 1.0), Importance.LOW,
+                 f"Below this fraction of capacity a broker is considered idle for {res} balancing.")
+    d.define("replica.count.balance.threshold", Type.DOUBLE, 1.10, at_least(1.0), Importance.MEDIUM,
+             "Margin around the average replica count per broker that counts as balanced.")
+    d.define("leader.replica.count.balance.threshold", Type.DOUBLE, 1.10, at_least(1.0), Importance.MEDIUM,
+             "Margin around the average leader count per broker that counts as balanced.")
+    d.define("topic.replica.count.balance.threshold", Type.DOUBLE, 3.00, at_least(1.0), Importance.LOW,
+             "Margin around the average per-topic replica count per broker.")
+    d.define("goal.violation.distribution.threshold.multiplier", Type.DOUBLE, 1.00, at_least(1.0), Importance.MEDIUM,
+             "Relaxation multiplier applied to distribution-goal thresholds during self-healing.")
+    d.define("max.replicas.per.broker", Type.LONG, 10000, at_least(0), Importance.MEDIUM,
+             "Hard cap on replicas per broker (ReplicaCapacityGoal).")
+    d.define("proposal.expiration.ms", Type.LONG, 900000, at_least(0), Importance.MEDIUM,
+             "Precomputed proposals older than this are discarded and recomputed.")
+    d.define("max.proposal.candidates", Type.INT, 10, at_least(1), Importance.LOW,
+             "Precomputed proposal candidates kept per computation round.")
+    d.define("num.proposal.precompute.threads", Type.INT, 1, at_least(1), Importance.LOW,
+             "Worker threads for background proposal precomputation.")
+    d.define("default.goals", Type.LIST, ",".join(DEFAULT_GOALS), None, Importance.HIGH,
+             "Goals used (in priority order) when a request does not name goals.")
+    d.define("goals", Type.LIST, ",".join(DEFAULT_GOALS), None, Importance.HIGH,
+             "All goals this instance may use.")
+    d.define("hard.goals", Type.LIST, ",".join(HARD_GOALS), None, Importance.HIGH,
+             "Goals that must be satisfied by every proposal.")
+    d.define("anomaly.detection.goals", Type.LIST, ",".join(ANOMALY_DETECTION_GOALS), None, Importance.MEDIUM,
+             "Goals the goal-violation detector dry-runs.")
+    # --- optimizer (TPU-native keys; no reference equivalent)
+    d.define("optimizer.batch.actions.per.round", Type.INT, 16, at_least(1), Importance.MEDIUM,
+             "Max non-conflicting actions applied per batched-greedy round (1 = faithful greedy).")
+    d.define("optimizer.max.rounds.per.goal", Type.INT, 64, at_least(1), Importance.MEDIUM,
+             "Upper bound on batched-greedy rounds per goal.")
+    d.define("optimizer.candidate.replicas.per.broker", Type.INT, 8, at_least(1), Importance.MEDIUM,
+             "Top-k replicas per overloaded broker considered as move sources each round.")
+    # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
+    d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
+             "Width of one partition-metric aggregation window.")
+    d.define("num.partition.metrics.windows", Type.INT, 1, at_least(1), Importance.HIGH,
+             "Number of partition-metric windows retained.")
+    d.define("min.samples.per.partition.metrics.window", Type.INT, 1, at_least(1), Importance.MEDIUM,
+             "Minimum samples for a partition window to be valid without extrapolation.")
+    d.define("broker.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
+             "Width of one broker-metric aggregation window.")
+    d.define("num.broker.metrics.windows", Type.INT, 20, at_least(1), Importance.HIGH,
+             "Number of broker-metric windows retained.")
+    d.define("min.samples.per.broker.metrics.window", Type.INT, 1, at_least(1), Importance.MEDIUM,
+             "Minimum samples for a broker window to be valid without extrapolation.")
+    d.define("metric.sampling.interval.ms", Type.LONG, 120000, at_least(1), Importance.MEDIUM,
+             "Period of the sampling loop.")
+    d.define("num.metric.fetchers", Type.INT, 1, at_least(1), Importance.LOW,
+             "Parallel sampling fetchers; partitions are assigned across them.")
+    d.define("metric.sampler.class", Type.CLASS,
+             "cruise_control_tpu.monitor.sampling.NoopSampler", None, Importance.MEDIUM,
+             "MetricSampler implementation (pluggable).")
+    d.define("sample.store.class", Type.CLASS,
+             "cruise_control_tpu.monitor.sampling.NoopSampleStore", None, Importance.MEDIUM,
+             "SampleStore implementation (pluggable); replayed on startup.")
+    d.define("broker.capacity.config.resolver.class", Type.CLASS,
+             "cruise_control_tpu.config.capacity.BrokerCapacityConfigFileResolver", None, Importance.MEDIUM,
+             "BrokerCapacityConfigResolver implementation.")
+    d.define("capacity.config.file", Type.STRING, "config/capacity.json", None, Importance.MEDIUM,
+             "JSON file of per-broker capacities for the file resolver.")
+    d.define("min.valid.partition.ratio", Type.DOUBLE, 0.995, between(0.0, 1.0), Importance.MEDIUM,
+             "Minimum monitored-partition fraction for a model to be considered complete.")
+    d.define("leader.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.6, at_least(0.0), Importance.LOW,
+             "Fixed-coefficient CPU attribution: weight of leader bytes-in (ModelUtils).")
+    d.define("follower.network.inbound.weight.for.cpu.util", Type.DOUBLE, 0.3, at_least(0.0), Importance.LOW,
+             "Fixed-coefficient CPU attribution: weight of follower bytes-in (ModelUtils).")
+    d.define("leader.network.outbound.weight.for.cpu.util", Type.DOUBLE, 0.1, at_least(0.0), Importance.LOW,
+             "Fixed-coefficient CPU attribution: weight of leader bytes-out (ModelUtils).")
+    d.define("use.linear.regression.model", Type.BOOLEAN, False, None, Importance.LOW,
+             "Use the trained linear-regression CPU model instead of fixed coefficients.")
+    # --- executor (reference defaults in cruisecontrol.properties)
+    d.define("num.concurrent.partition.movements.per.broker", Type.INT, 10, at_least(1), Importance.HIGH,
+             "In-flight inter-broker replica moves allowed per broker.")
+    d.define("num.concurrent.leader.movements", Type.INT, 1000, at_least(1), Importance.HIGH,
+             "In-flight leadership moves allowed cluster-wide.")
+    d.define("execution.progress.check.interval.ms", Type.LONG, 10000, at_least(1), Importance.MEDIUM,
+             "Poll period for task completion during execution.")
+    d.define("default.replica.movement.strategies", Type.LIST,
+             "cruise_control_tpu.executor.strategy.BaseReplicaMovementStrategy", None, Importance.LOW,
+             "Strategy chain ordering replica movements.")
+    d.define("removed.broker.history.retention.ms", Type.LONG, 43200000, at_least(0), Importance.LOW,
+             "How long removed-broker history is kept.")
+    d.define("demoted.broker.history.retention.ms", Type.LONG, 43200000, at_least(0), Importance.LOW,
+             "How long demoted-broker history is kept.")
+    # --- anomaly detection (reference defaults at KafkaCruiseControlConfig.java)
+    d.define("anomaly.detection.interval.ms", Type.LONG, 300000, at_least(1), Importance.MEDIUM,
+             "Period of the anomaly detectors.")
+    d.define("anomaly.notifier.class", Type.CLASS,
+             "cruise_control_tpu.detector.notifier.NoopNotifier", None, Importance.MEDIUM,
+             "AnomalyNotifier implementation.")
+    d.define("metric.anomaly.finder.class", Type.CLASS,
+             "cruise_control_tpu.detector.metric_anomaly.NoopMetricAnomalyFinder", None, Importance.LOW,
+             "MetricAnomalyFinder implementation.")
+    d.define("metric.anomaly.percentile.upper.threshold", Type.DOUBLE, 90.0, between(0.0, 100.0), Importance.LOW,
+             "Percentile above which a current metric is anomalous.")
+    d.define("metric.anomaly.percentile.lower.threshold", Type.DOUBLE, 10.0, between(0.0, 100.0), Importance.LOW,
+             "Percentile below which a current metric is anomalous.")
+    d.define("self.healing.enabled", Type.BOOLEAN, False, None, Importance.HIGH,
+             "Master switch for self-healing on detected anomalies.")
+    d.define("broker.failure.alert.threshold.ms", Type.LONG, 900000, at_least(0), Importance.MEDIUM,
+             "Grace period before a broker failure raises an alert.")
+    d.define("broker.failure.self.healing.threshold.ms", Type.LONG, 1800000, at_least(0), Importance.MEDIUM,
+             "Grace period before a broker failure triggers self-healing.")
+    d.define("failed.brokers.file.path", Type.STRING, "failed_brokers.json", None, Importance.LOW,
+             "Where failed-broker times are persisted across restarts.")
+    # --- webserver / user tasks (reference defaults at KafkaCruiseControlConfig.java:861+)
+    d.define("webserver.http.port", Type.INT, 9090, at_least(0), Importance.HIGH, "REST port.")
+    d.define("webserver.http.address", Type.STRING, "127.0.0.1", None, Importance.HIGH, "REST bind address.")
+    d.define("webserver.api.urlprefix", Type.STRING, "/kafkacruisecontrol/*", None, Importance.LOW, "API prefix.")
+    d.define("webserver.http.cors.enabled", Type.BOOLEAN, False, None, Importance.LOW, "Enable CORS headers.")
+    d.define("max.active.user.tasks", Type.INT, 5, at_least(1), Importance.MEDIUM,
+             "Concurrent async user tasks allowed.")
+    d.define("max.cached.completed.user.tasks", Type.INT, 25, at_least(0), Importance.LOW,
+             "Completed user tasks kept for result retrieval.")
+    d.define("completed.user.task.retention.time.ms", Type.LONG, 86400000, at_least(0), Importance.LOW,
+             "How long completed user tasks are retained.")
+    d.define("two.step.verification.enabled", Type.BOOLEAN, False, None, Importance.LOW,
+             "Require review/approval of POST requests via the purgatory.")
+    d.define("two.step.purgatory.max.requests", Type.INT, 25, at_least(1), Importance.LOW,
+             "Max requests parked in the purgatory.")
+    d.define("two.step.purgatory.retention.time.ms", Type.LONG, 1209600000, at_least(0), Importance.LOW,
+             "Retention of reviewed requests in the purgatory.")
+    # --- TPU execution
+    d.define("tpu.mesh.axis.name", Type.STRING, "shard", None, Importance.LOW,
+             "Mesh axis name candidate/partition arrays are sharded over.")
+    d.define("tpu.donate.model.buffers", Type.BOOLEAN, True, None, Importance.LOW,
+             "Donate model buffers between optimizer rounds to avoid copies.")
+    return d
+
+
+_DEF = _config_def()
+
+
+def _simple_goal_name(name: str) -> str:
+    """Accept reference Java class paths by mapping to their simple name."""
+    return name.rsplit(".", 1)[-1]
+
+
+class CruiseControlConfig(AbstractConfig):
+    def __init__(self, props: Mapping[str, Any] | None = None):
+        super().__init__(_DEF, dict(props or {}))
+
+    @classmethod
+    def from_properties_file(cls, path: str) -> "CruiseControlConfig":
+        return cls(load_properties(path))
+
+    def goal_names(self, key: str = "default.goals") -> List[str]:
+        return [_simple_goal_name(g) for g in self.get_list(key)]

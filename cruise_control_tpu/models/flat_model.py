@@ -1,0 +1,337 @@
+"""FlatClusterModel: the cluster workload model as a pytree of device arrays.
+
+This replaces the reference's mutable object graph (cc/model/ClusterModel.java:
+racks -> hosts -> brokers -> replicas with per-entity `Load`) with a dense,
+static-shape representation designed for the MXU/XLA:
+
+  assignment : i32[P, R]  broker index per replica slot; slot 0 is the leader
+                          (matching cc/model/Partition.java:95 semantics);
+                          -1 marks an unused (padded) slot.
+  part_load  : f32[P, M]  per-partition expected utilization per PartMetric,
+                          windows pre-reduced host-side the way
+                          Load.expectedUtilizationFor does (cc/model/Load.java).
+  topic_id   : i32[P]     topic of each partition.
+  broker_capacity : f32[B, 4]  capacity per Resource (CPU in cores*100, rates
+                          in KB/s, disk in MB — same units as the reference's
+                          capacity.json).
+  broker_rack / broker_host : i32[B]
+  broker_state : i32[B]   BrokerState (ALIVE/NEW/DEMOTED/DEAD).
+
+All per-broker aggregates are segment-sums over the (P*R) replica slots —
+`ClusterModel.utilizationMatrix` (cc/model/ClusterModel.java:1113) already
+proves the dense form carries everything the goals need.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import (
+    NUM_RESOURCES,
+    BrokerState,
+    PartMetric,
+)
+
+
+class FlatClusterModel(NamedTuple):
+    assignment: jax.Array  # i32[P, R]
+    part_load: jax.Array  # f32[P, M]
+    topic_id: jax.Array  # i32[P]
+    broker_capacity: jax.Array  # f32[B, 4]
+    broker_rack: jax.Array  # i32[B]
+    broker_host: jax.Array  # i32[B]
+    broker_state: jax.Array  # i32[B]
+
+    @property
+    def num_partitions(self) -> int:
+        return self.assignment.shape[0]
+
+    @property
+    def max_replication_factor(self) -> int:
+        return self.assignment.shape[1]
+
+    @property
+    def num_brokers(self) -> int:
+        return self.broker_capacity.shape[0]
+
+    @property
+    def num_topics(self) -> int:
+        # static metadata: topic ids are dense [0, T)
+        return int(np.asarray(self.topic_id).max()) + 1 if self.topic_id.shape[0] else 0
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterMetadata:
+    """Host-side naming metadata kept out of the jitted pytree."""
+
+    topic_names: tuple
+    partition_index: np.ndarray  # i32[P] partition number within its topic
+    broker_ids: np.ndarray  # i32[B] external broker ids
+    rack_names: tuple = ()
+    host_names: tuple = ()
+    topic_of_partition: np.ndarray = None  # i32[P]
+
+    def topic_partition(self, p: int) -> str:
+        """Render partition p as 'topic-partitionIndex' for proposals/REST."""
+        if self.topic_of_partition is None:
+            raise ValueError("ClusterMetadata built without topic_of_partition")
+        t = int(self.topic_of_partition[p])
+        return f"{self.topic_names[t]}-{int(self.partition_index[p])}"
+
+
+# -- basic masks ---------------------------------------------------------------
+
+
+def valid_slot_mask(model: FlatClusterModel) -> jax.Array:
+    """bool[P, R]: which replica slots are populated."""
+    return model.assignment >= 0
+
+
+def replication_factor(model: FlatClusterModel) -> jax.Array:
+    """i32[P]: replicas per partition."""
+    return jnp.sum(valid_slot_mask(model), axis=1, dtype=jnp.int32)
+
+
+def alive_broker_mask(model: FlatClusterModel) -> jax.Array:
+    """bool[B]: brokers that can receive replicas (not DEAD)."""
+    return model.broker_state != BrokerState.DEAD
+
+
+def new_broker_mask(model: FlatClusterModel) -> jax.Array:
+    return model.broker_state == BrokerState.NEW
+
+
+def dead_broker_mask(model: FlatClusterModel) -> jax.Array:
+    return model.broker_state == BrokerState.DEAD
+
+
+# -- per-broker aggregates -----------------------------------------------------
+
+
+def leader_contribution(part_load: jax.Array) -> jax.Array:
+    """f32[P, 4]: per-Resource load a partition places on its leader broker.
+
+    Exact column selection (no matmul) so results are bit-identical across
+    CPU/TPU.
+    """
+    return jnp.stack(
+        [
+            part_load[:, PartMetric.CPU_LEADER],
+            part_load[:, PartMetric.NW_IN_LEADER],
+            part_load[:, PartMetric.NW_OUT_LEADER],
+            part_load[:, PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+
+
+def follower_contribution(part_load: jax.Array) -> jax.Array:
+    """f32[P, 4]: per-Resource load a partition places on each follower broker."""
+    zeros = jnp.zeros_like(part_load[:, 0])
+    return jnp.stack(
+        [
+            part_load[:, PartMetric.CPU_FOLLOWER],
+            part_load[:, PartMetric.NW_IN_FOLLOWER],
+            zeros,
+            part_load[:, PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+
+
+def _segment_ids(model: FlatClusterModel) -> jax.Array:
+    """Broker id per slot with pads routed to an overflow bucket B."""
+    b = model.num_brokers
+    return jnp.where(valid_slot_mask(model), model.assignment, b)
+
+
+def broker_loads(model: FlatClusterModel) -> jax.Array:
+    """f32[B, 4] per-broker utilization per Resource.
+
+    leader slots contribute part_load @ LEADER_CONTRIB, follower slots
+    part_load @ FOLLOWER_CONTRIB — the same split ClusterModel maintains via
+    relocateLeadership (cc/model/ClusterModel.java:307-339).
+    """
+    p, r = model.assignment.shape
+    b = model.num_brokers
+    leader_vec = leader_contribution(model.part_load)  # f32[P, 4]
+    follower_vec = follower_contribution(model.part_load)  # f32[P, 4]
+    is_leader = jnp.arange(r) == 0  # bool[R]
+    contrib = jnp.where(
+        is_leader[None, :, None], leader_vec[:, None, :], follower_vec[:, None, :]
+    )  # f32[P, R, 4]
+    seg = _segment_ids(model).reshape(p * r)
+    out = jax.ops.segment_sum(contrib.reshape(p * r, NUM_RESOURCES), seg, num_segments=b + 1)
+    return out[:b]
+
+
+def replica_counts(model: FlatClusterModel) -> jax.Array:
+    """i32[B] replicas per broker."""
+    p, r = model.assignment.shape
+    seg = _segment_ids(model).reshape(p * r)
+    ones = jnp.ones((p * r,), dtype=jnp.int32)
+    return jax.ops.segment_sum(ones, seg, num_segments=model.num_brokers + 1)[: model.num_brokers]
+
+
+def leader_counts(model: FlatClusterModel) -> jax.Array:
+    """i32[B] leader replicas per broker."""
+    b = model.num_brokers
+    leaders = jnp.where(model.assignment[:, 0] >= 0, model.assignment[:, 0], b)
+    ones = jnp.ones_like(leaders, dtype=jnp.int32)
+    return jax.ops.segment_sum(ones, leaders, num_segments=b + 1)[:b]
+
+
+def potential_nw_out(model: FlatClusterModel) -> jax.Array:
+    """f32[B]: NW_OUT each broker would carry if every replica it hosts led.
+
+    Mirrors ClusterModel._potentialLeadershipLoadByBrokerId /
+    potentialLeadershipLoadFor (cc/model/ClusterModel.java:64,:183).
+    """
+    p, r = model.assignment.shape
+    nw_out = model.part_load[:, PartMetric.NW_OUT_LEADER]
+    contrib = jnp.broadcast_to(nw_out[:, None], (p, r)).reshape(p * r)
+    seg = _segment_ids(model).reshape(p * r)
+    return jax.ops.segment_sum(contrib, seg, num_segments=model.num_brokers + 1)[
+        : model.num_brokers
+    ]
+
+
+def topic_replica_counts(model: FlatClusterModel, num_topics: int) -> jax.Array:
+    """i32[T, B] replicas of each topic on each broker (TopicReplicaDistributionGoal)."""
+    p, r = model.assignment.shape
+    b = model.num_brokers
+    seg_b = _segment_ids(model)  # [P, R] in [0, B]
+    topic = jnp.broadcast_to(model.topic_id[:, None], (p, r))
+    flat = (topic * (b + 1) + seg_b).reshape(p * r)
+    ones = jnp.ones((p * r,), dtype=jnp.int32)
+    counts = jax.ops.segment_sum(ones, flat, num_segments=num_topics * (b + 1))
+    return counts.reshape(num_topics, b + 1)[:, :b]
+
+
+def host_loads(model: FlatClusterModel, num_hosts: int) -> jax.Array:
+    """f32[H, 4]: broker loads aggregated per host (CPU capacity is host-level)."""
+    loads = broker_loads(model)
+    return jax.ops.segment_sum(loads, model.broker_host, num_segments=num_hosts)
+
+
+def host_capacity(model: FlatClusterModel, num_hosts: int) -> jax.Array:
+    """f32[H, 4]: per-host capacity = sum of its brokers' capacities."""
+    return jax.ops.segment_sum(model.broker_capacity, model.broker_host, num_segments=num_hosts)
+
+
+def utilization_matrix(model: FlatClusterModel) -> jax.Array:
+    """f32[7, B]: derived-resource x broker matrix.
+
+    Same axes as ClusterModel.utilizationMatrix (cc/model/ClusterModel.java:1113)
+    over RawAndDerivedResource: DISK, CPU, LEADER_NW_IN, FOLLOWER_NW_IN, NW_OUT,
+    PWN_NW_OUT, REPLICAS.
+    """
+    p, r = model.assignment.shape
+    b = model.num_brokers
+    seg = _segment_ids(model).reshape(p * r)
+    is_leader = (jnp.arange(r) == 0)[None, :]
+
+    def seg_sum(per_slot):
+        return jax.ops.segment_sum(per_slot.reshape(p * r), seg, num_segments=b + 1)[:b]
+
+    disk = seg_sum(jnp.broadcast_to(model.part_load[:, PartMetric.DISK : PartMetric.DISK + 1], (p, r)))
+    cpu = seg_sum(
+        jnp.where(
+            is_leader,
+            model.part_load[:, PartMetric.CPU_LEADER, None],
+            model.part_load[:, PartMetric.CPU_FOLLOWER, None],
+        )
+    )
+    leader_nw_in = seg_sum(jnp.where(is_leader, model.part_load[:, PartMetric.NW_IN_LEADER, None], 0.0))
+    follower_nw_in = seg_sum(
+        jnp.where(is_leader, 0.0, model.part_load[:, PartMetric.NW_IN_FOLLOWER, None])
+    )
+    nw_out = seg_sum(jnp.where(is_leader, model.part_load[:, PartMetric.NW_OUT_LEADER, None], 0.0))
+    pwn_nw_out = seg_sum(
+        jnp.broadcast_to(model.part_load[:, PartMetric.NW_OUT_LEADER, None], (p, r))
+    )
+    replicas = seg_sum(jnp.ones((p, r), dtype=jnp.float32) * valid_slot_mask(model))
+    return jnp.stack([disk, cpu, leader_nw_in, follower_nw_in, nw_out, pwn_nw_out, replicas])
+
+
+# -- action application --------------------------------------------------------
+
+
+def relocate_replica(model: FlatClusterModel, p, slot, dst_broker) -> FlatClusterModel:
+    """Move the replica in (partition p, slot) to dst_broker.
+
+    Equivalent of ClusterModel.relocateReplica (cc/model/ClusterModel.java:280):
+    leadership stays with the slot, so moving slot 0 moves leadership load too —
+    the dense layout gets that for free.
+    """
+    a = jnp.asarray(model.assignment)
+    return model._replace(assignment=a.at[p, slot].set(dst_broker))
+
+
+def relocate_leadership(model: FlatClusterModel, p, slot) -> FlatClusterModel:
+    """Make the replica in (p, slot) the leader by swapping slots 0 and slot.
+
+    Equivalent of ClusterModel.relocateLeadership
+    (cc/model/ClusterModel.java:307-339): the NW_OUT load and the leadership
+    CPU/NW_IN split move to the new leader because contribution is a function
+    of slot index.
+    """
+    a = jnp.asarray(model.assignment)
+    old_leader = a[p, 0]
+    new_leader = a[p, slot]
+    a = a.at[p, 0].set(new_leader)
+    a = a.at[p, slot].set(old_leader)
+    return model._replace(assignment=a)
+
+
+def swap_replicas(
+    model: FlatClusterModel, p1, slot1, p2, slot2
+) -> FlatClusterModel:
+    """Swap the brokers of (p1, slot1) and (p2, slot2).
+
+    Equivalent of AbstractGoal.maybeApplySwapAction's model mutation
+    (cc/analyzer/goals/AbstractGoal.java:240-290).
+    """
+    a = jnp.asarray(model.assignment)
+    b1 = a[p1, slot1]
+    b2 = a[p2, slot2]
+    a = a.at[p1, slot1].set(b2)
+    a = a.at[p2, slot2].set(b1)
+    return model._replace(assignment=a)
+
+
+# -- invariants ---------------------------------------------------------------
+
+
+def sanity_check(model: FlatClusterModel) -> None:
+    """Invariant checker, the analog of ClusterModel.sanityCheck
+    (cc/model/ClusterModel.java:918). Host-side; raises on violation."""
+    a = np.asarray(model.assignment)
+    b = model.num_brokers
+    valid = a >= 0
+    if not valid[:, 0].all():
+        raise ValueError("every partition must have a leader in slot 0")
+    if (a >= b).any():
+        raise ValueError("assignment references nonexistent broker")
+    # no partition may have two replicas on one broker
+    p, r = a.shape
+    masked = np.where(valid, a, -np.arange(p * r).reshape(p, r) - 1)
+    sorted_rows = np.sort(masked, axis=1)
+    if (sorted_rows[:, 1:] == sorted_rows[:, :-1]).any():
+        raise ValueError("partition has two replicas on the same broker")
+    # valid slots must be left-packed so RF == count of leading valid slots
+    first_invalid = np.argmin(valid, axis=1)
+    rf = valid.sum(axis=1)
+    packed = (rf == r) | (first_invalid == rf)
+    if not packed.all():
+        raise ValueError("replica slots must be left-packed")
+    load = np.asarray(model.part_load)
+    if (load < 0).any() or not np.isfinite(load).all():
+        raise ValueError("partition loads must be finite and non-negative")
+    if np.asarray(model.broker_rack).shape[0] != b or np.asarray(model.broker_host).shape[0] != b:
+        raise ValueError("broker attribute arrays disagree on broker count")

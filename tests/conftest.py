@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+Multi-chip TPU hardware is not available in CI; sharding/pjit paths are
+validated on 8 virtual CPU devices instead (same XLA partitioner). The axon
+site customization pins jax_platforms programmatically, so the env var alone
+is not enough — jax.config must be updated before any backend initializes.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,229 @@
+"""FlatClusterModel kernels vs a straightforward numpy oracle."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.common.resources import BrokerState, PartMetric, Resource
+from cruise_control_tpu.models import flat_model as fm
+from cruise_control_tpu.models import generators as gen
+
+
+def oracle_broker_loads(model) -> np.ndarray:
+    a = np.asarray(model.assignment)
+    load = np.asarray(model.part_load)
+    b = model.num_brokers
+    out = np.zeros((b, 4), dtype=np.float64)
+    for p in range(a.shape[0]):
+        for r in range(a.shape[1]):
+            br = a[p, r]
+            if br < 0:
+                continue
+            if r == 0:
+                out[br, Resource.CPU] += load[p, PartMetric.CPU_LEADER]
+                out[br, Resource.NW_IN] += load[p, PartMetric.NW_IN_LEADER]
+                out[br, Resource.NW_OUT] += load[p, PartMetric.NW_OUT_LEADER]
+            else:
+                out[br, Resource.CPU] += load[p, PartMetric.CPU_FOLLOWER]
+                out[br, Resource.NW_IN] += load[p, PartMetric.NW_IN_FOLLOWER]
+            out[br, Resource.DISK] += load[p, PartMetric.DISK]
+    return out
+
+
+@pytest.fixture(params=["unbalanced", "rack_aware_violated", "capacity_violated", "random"])
+def model(request):
+    if request.param == "random":
+        return gen.random_cluster(7, gen.ClusterProperty(num_brokers=12, num_racks=4,
+                                                         num_topics=8, replication_factor=3))
+    return getattr(gen, request.param)()
+
+
+def test_sanity_check_passes(model):
+    fm.sanity_check(model)
+
+
+def test_broker_loads_match_oracle(model):
+    got = np.asarray(fm.broker_loads(model))
+    want = oracle_broker_loads(model)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_replica_and_leader_counts(model):
+    a = np.asarray(model.assignment)
+    b = model.num_brokers
+    want_rc = np.zeros(b, dtype=int)
+    want_lc = np.zeros(b, dtype=int)
+    for p in range(a.shape[0]):
+        for r in range(a.shape[1]):
+            if a[p, r] >= 0:
+                want_rc[a[p, r]] += 1
+        want_lc[a[p, 0]] += 1
+    np.testing.assert_array_equal(np.asarray(fm.replica_counts(model)), want_rc)
+    np.testing.assert_array_equal(np.asarray(fm.leader_counts(model)), want_lc)
+
+
+def test_potential_nw_out(model):
+    a = np.asarray(model.assignment)
+    nw = np.asarray(model.part_load)[:, PartMetric.NW_OUT_LEADER]
+    b = model.num_brokers
+    want = np.zeros(b)
+    for p in range(a.shape[0]):
+        for r in range(a.shape[1]):
+            if a[p, r] >= 0:
+                want[a[p, r]] += nw[p]
+    np.testing.assert_allclose(np.asarray(fm.potential_nw_out(model)), want, rtol=1e-5)
+
+
+def test_relocate_replica_moves_load():
+    m = gen.unbalanced()
+    before = np.asarray(fm.broker_loads(m))
+    # partition 0 follower (slot 1) is on broker 1; move it to broker 2
+    m2 = fm.relocate_replica(m, 0, 1, 2)
+    fm.sanity_check(m2)
+    after = np.asarray(fm.broker_loads(m2))
+    load = np.asarray(m.part_load)[0]
+    np.testing.assert_allclose(
+        before[1] - after[1],
+        [load[PartMetric.CPU_FOLLOWER], load[PartMetric.NW_IN_FOLLOWER], 0.0, load[PartMetric.DISK]],
+        rtol=1e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(after[2] - before[2], before[1] - after[1], rtol=1e-5, atol=1e-6)
+
+
+def test_relocate_leadership_transfers_nw_out():
+    m = gen.unbalanced()
+    before = np.asarray(fm.broker_loads(m))
+    m2 = fm.relocate_leadership(m, 0, 1)  # leadership p0: broker0 -> broker1
+    fm.sanity_check(m2)
+    after = np.asarray(fm.broker_loads(m2))
+    load = np.asarray(m.part_load)[0]
+    # broker 0 loses leader NW_OUT entirely, and the leader-vs-follower deltas
+    d_cpu = load[PartMetric.CPU_LEADER] - load[PartMetric.CPU_FOLLOWER]
+    d_nwin = load[PartMetric.NW_IN_LEADER] - load[PartMetric.NW_IN_FOLLOWER]
+    np.testing.assert_allclose(
+        before[0] - after[0],
+        [d_cpu, d_nwin, load[PartMetric.NW_OUT_LEADER], 0.0],
+        rtol=1e-5, atol=1e-5,
+    )
+    # disk unchanged everywhere
+    np.testing.assert_allclose(after[:, Resource.DISK], before[:, Resource.DISK], rtol=1e-6)
+
+
+def test_swap_replicas():
+    m = gen.random_cluster(3, gen.ClusterProperty(num_brokers=8, num_racks=4,
+                                                  num_topics=4, rack_aware_placement=False))
+    a = np.asarray(m.assignment)
+    # find two partitions with disjoint broker sets to keep sanity
+    p1, p2 = None, None
+    for i in range(a.shape[0]):
+        for j in range(i + 1, a.shape[0]):
+            if not set(a[i]) & set(a[j]):
+                p1, p2 = i, j
+                break
+        if p1 is not None:
+            break
+    assert p1 is not None
+    m2 = fm.swap_replicas(m, p1, 1, p2, 1)
+    fm.sanity_check(m2)
+    a2 = np.asarray(m2.assignment)
+    assert a2[p1, 1] == a[p2, 1] and a2[p2, 1] == a[p1, 1]
+
+
+def test_topic_replica_counts(model):
+    t = int(np.asarray(model.topic_id).max()) + 1
+    got = np.asarray(fm.topic_replica_counts(model, t))
+    a = np.asarray(model.assignment)
+    tid = np.asarray(model.topic_id)
+    want = np.zeros((t, model.num_brokers), dtype=int)
+    for p in range(a.shape[0]):
+        for r in range(a.shape[1]):
+            if a[p, r] >= 0:
+                want[tid[p], a[p, r]] += 1
+    np.testing.assert_array_equal(got, want)
+
+
+def test_utilization_matrix_consistency(model):
+    um = np.asarray(fm.utilization_matrix(model))
+    loads = np.asarray(fm.broker_loads(model))
+    np.testing.assert_allclose(um[0], loads[:, Resource.DISK], rtol=1e-5)
+    np.testing.assert_allclose(um[1], loads[:, Resource.CPU], rtol=1e-5)
+    np.testing.assert_allclose(um[2] + um[3], loads[:, Resource.NW_IN], rtol=1e-5)
+    np.testing.assert_allclose(um[4], loads[:, Resource.NW_OUT], rtol=1e-5)
+    np.testing.assert_allclose(um[5], np.asarray(fm.potential_nw_out(model)), rtol=1e-5)
+    np.testing.assert_allclose(um[6], np.asarray(fm.replica_counts(model)), rtol=1e-5)
+
+
+def test_sanity_check_catches_duplicate_broker():
+    m = gen.unbalanced()
+    a = np.asarray(m.assignment).copy()
+    a[0, 1] = a[0, 0]
+    with pytest.raises(ValueError, match="same broker"):
+        fm.sanity_check(m._replace(assignment=a))
+
+
+def test_random_cluster_rack_aware_placement():
+    m = gen.random_cluster(11, gen.ClusterProperty(num_brokers=20, num_racks=5,
+                                                   num_topics=10, replication_factor=3))
+    fm.sanity_check(m)
+    a = np.asarray(m.assignment)
+    racks = np.asarray(m.broker_rack)[a]
+    racks_sorted = np.sort(racks, axis=1)
+    assert not (racks_sorted[:, 1:] == racks_sorted[:, :-1]).any()
+
+
+@pytest.mark.parametrize("rf", [1, 2, 3])
+def test_random_cluster_mean_utilization(rf):
+    prop = gen.ClusterProperty(num_brokers=30, num_racks=6, num_topics=30,
+                               mean_utilization=0.4, replication_factor=rf)
+    m = gen.random_cluster(5, prop)
+    loads = np.asarray(fm.broker_loads(m))
+    cap = np.asarray(m.broker_capacity)
+    mean_util = loads.sum(0) / cap.sum(0)
+    for res in (Resource.CPU, Resource.NW_OUT, Resource.DISK):
+        assert abs(mean_util[res] - 0.4) < 0.02, (res, mean_util)
+
+
+def test_random_cluster_more_racks_than_brokers():
+    # racks without brokers must not be chosen as placement targets
+    m = gen.random_cluster(1, gen.ClusterProperty(num_brokers=3, num_racks=5,
+                                                  num_topics=3, replication_factor=2))
+    fm.sanity_check(m)
+
+
+def test_metadata_partition_index():
+    m = gen.random_cluster(9, gen.ClusterProperty(num_brokers=6, num_racks=3, num_topics=5))
+    md = gen.metadata_for(m)
+    tid = np.asarray(m.topic_id)
+    seen: dict = {}
+    for p in range(tid.shape[0]):
+        want = seen.get(int(tid[p]), 0)
+        assert md.partition_index[p] == want
+        seen[int(tid[p])] = want + 1
+    assert md.topic_partition(0) == f"topic-{tid[0]}-0"
+
+
+def test_config_defaults_and_properties_roundtrip(tmp_path):
+    from cruise_control_tpu.config import BalancingConstraint, CruiseControlConfig
+
+    cfg = CruiseControlConfig()
+    assert cfg.get_double("cpu.balance.threshold") == 1.10
+    assert cfg.get_long("max.replicas.per.broker") == 10000
+    assert cfg.goal_names()[0] == "RackAwareGoal"
+
+    props = tmp_path / "cc.properties"
+    props.write_text("cpu.balance.threshold=1.3\n# comment\ndefault.goals=RackAwareGoal,ReplicaCapacityGoal\n")
+    cfg2 = CruiseControlConfig.from_properties_file(str(props))
+    assert cfg2.get_double("cpu.balance.threshold") == 1.3
+    assert cfg2.goal_names() == ["RackAwareGoal", "ReplicaCapacityGoal"]
+
+    bc = BalancingConstraint.from_config(cfg2)
+    assert bc.resource_balance_percentage[Resource.CPU] == np.float32(1.3)
+    assert bc.capacity_threshold[Resource.DISK] == np.float32(0.8)
+
+
+def test_config_validation():
+    from cruise_control_tpu.config import ConfigException, CruiseControlConfig
+
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"cpu.balance.threshold": "0.5"})  # must be >= 1
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"webserver.http.port": "abc"})

@@ -1,0 +1,29 @@
+"""Analyzer: goal kernels + the batched-greedy optimizer.
+
+The TPU-native re-design of the reference's analyzer subsystem
+(cc/analyzer/: GoalOptimizer, Goal SPI, AbstractGoal greedy engine). Goals are
+pure vectorized functions over the FlatClusterModel; the optimizer scores
+candidate actions in batch with vmap/top-k and applies shortlisted actions via
+a sequentially re-validated lax.scan, preserving the reference's
+goal-priority semantics while replacing its one-action-at-a-time greedy.
+"""
+
+from cruise_control_tpu.analyzer.stats import ClusterModelStats, compute_stats
+from cruise_control_tpu.analyzer.actions import ActionBatch, BalancingAction
+from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY, get_goal, goals_by_priority
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerResult
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
+
+__all__ = [
+    "ClusterModelStats",
+    "compute_stats",
+    "ActionBatch",
+    "BalancingAction",
+    "GOAL_REGISTRY",
+    "get_goal",
+    "goals_by_priority",
+    "GoalOptimizer",
+    "OptimizerResult",
+    "ExecutionProposal",
+    "proposal_diff",
+]

@@ -1,0 +1,213 @@
+"""Balancing actions as broadcast-friendly array batches.
+
+The reference's `BalancingAction` (cc/analyzer/BalancingAction.java:17) is one
+(topic-partition, source, destination, type) object; `AbstractGoal` walks them
+one at a time. Here a *batch* of candidate actions is a struct of arrays with
+mutually broadcastable shapes, so a [P, R, K] grid of (partition, slot,
+destination) move candidates or a [P, R-1] grid of leadership candidates is
+scored by one fused kernel — the "hot loop" of
+`AbstractGoal.maybeApplyBalancingAction` (cc/analyzer/goals/AbstractGoal.java:186)
+becomes data parallelism.
+
+Action kinds mirror cc/analyzer/ActionType.java:24 (swaps are expressed as two
+coupled moves by the optimizer rather than a third kind).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import PartMetric, Resource
+
+KIND_MOVE = 0
+KIND_LEADERSHIP = 1
+
+#: Score bonus that makes dead-broker evacuation dominate any balance score:
+#: every goal must first ensure no replica remains on a dead broker
+#: (GoalUtils.ensureNoReplicaOnDeadBrokers semantics).
+DEAD_EVACUATION_BONUS = 1.0e6
+
+
+class ActionBatch(NamedTuple):
+    """A batch of candidate actions; all fields broadcast to a common shape.
+
+    kind  : i32[...]  KIND_MOVE or KIND_LEADERSHIP
+    p     : i32[...]  partition index
+    slot  : i32[...]  replica slot being moved (move) or promoted (leadership)
+    src   : i32[...]  broker losing load (current holder / current leader)
+    dst   : i32[...]  broker gaining load (move target / new leader)
+    valid : bool[...] structurally valid candidate (slot populated, src != dst, ...)
+    dload : f32[..., 4] per-Resource load transferred src -> dst (may have
+            negative components for leadership when follower NW_IN > leader NW_IN)
+    drep  : i32[...]  replica-count change at dst (+1 for moves)
+    dleader : i32[...] leader-count change at dst (1 when leadership transfers)
+    dpnw  : f32[...]  potential-NW_OUT transferred (moves only)
+    dleader_nw_in : f32[...] leader bytes-in transferred (leadership transfers)
+    """
+
+    kind: jax.Array
+    p: jax.Array
+    slot: jax.Array
+    src: jax.Array
+    dst: jax.Array
+    valid: jax.Array
+    dload: jax.Array
+    drep: jax.Array
+    dleader: jax.Array
+    dpnw: jax.Array
+    dleader_nw_in: jax.Array
+
+
+def _leader_vec(part_load: jax.Array, p: jax.Array) -> jax.Array:
+    """f32[..., 4] load the partitions `p` place on their leader."""
+    pl = part_load[p]  # [..., M]
+    return jnp.stack(
+        [
+            pl[..., PartMetric.CPU_LEADER],
+            pl[..., PartMetric.NW_IN_LEADER],
+            pl[..., PartMetric.NW_OUT_LEADER],
+            pl[..., PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+
+
+def _follower_vec(part_load: jax.Array, p: jax.Array) -> jax.Array:
+    pl = part_load[p]
+    zero = jnp.zeros_like(pl[..., 0])
+    return jnp.stack(
+        [
+            pl[..., PartMetric.CPU_FOLLOWER],
+            pl[..., PartMetric.NW_IN_FOLLOWER],
+            zero,
+            pl[..., PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+
+
+def make_move_batch(
+    part_load: jax.Array,
+    assignment: jax.Array,
+    dst_cands: jax.Array,
+) -> ActionBatch:
+    """Candidate grid: every replica slot x every destination candidate.
+
+    Shapes broadcast to [P, R, K] (fields are kept at their minimal broadcast
+    shape; no [P, R, K] materialization happens here).
+    """
+    p_count, r = assignment.shape
+    p = jnp.arange(p_count, dtype=jnp.int32)[:, None, None]  # [P,1,1]
+    slot = jnp.arange(r, dtype=jnp.int32)[None, :, None]  # [1,R,1]
+    src = assignment[:, :, None]  # [P,R,1]
+    dst = dst_cands[None, None, :]  # [1,1,K]
+
+    is_leader_slot = slot == 0
+    lead = _leader_vec(part_load, p)  # [P,1,1,4]
+    foll = _follower_vec(part_load, p)
+    dload = jnp.where(is_leader_slot[..., None], lead, foll)  # [P,R,1,4]
+
+    pl = part_load[p]  # [P,1,1,M]
+    valid = (src >= 0) & (src != dst)
+    return ActionBatch(
+        kind=jnp.full((1, 1, 1), KIND_MOVE, dtype=jnp.int32),
+        p=p,
+        slot=slot,
+        src=src,
+        dst=dst,
+        valid=valid,
+        dload=dload,
+        drep=jnp.ones((1, 1, 1), dtype=jnp.int32),
+        dleader=is_leader_slot.astype(jnp.int32),
+        dpnw=pl[..., PartMetric.NW_OUT_LEADER],
+        dleader_nw_in=jnp.where(
+            is_leader_slot, pl[..., PartMetric.NW_IN_LEADER], 0.0
+        ),
+    )
+
+
+def make_leadership_batch(part_load: jax.Array, assignment: jax.Array) -> ActionBatch:
+    """Candidate grid [P, R-1]: promote the replica in slot s (s >= 1) to leader.
+
+    The model mutation is a slot swap (flat_model.relocate_leadership); the load
+    delta is leader_vec - follower_vec moving from the old leader to the new,
+    mirroring ClusterModel.relocateLeadership (cc/model/ClusterModel.java:307).
+    """
+    p_count, r = assignment.shape
+    if r < 2:
+        raise ValueError("leadership batch requires max replication factor >= 2")
+    p = jnp.arange(p_count, dtype=jnp.int32)[:, None]  # [P,1]
+    slot = jnp.arange(1, r, dtype=jnp.int32)[None, :]  # [1,R-1]
+    src = assignment[:, 0:1]  # [P,1] current leader
+    dst = assignment[:, 1:]  # [P,R-1] new leader
+
+    lead = _leader_vec(part_load, p)  # [P,1,4]
+    foll = _follower_vec(part_load, p)
+    dload = lead - foll  # [P,1,4]
+
+    pl = part_load[p]  # [P,1,M]
+    valid = (dst >= 0) & (src >= 0)
+    return ActionBatch(
+        kind=jnp.full((1, 1), KIND_LEADERSHIP, dtype=jnp.int32),
+        p=p,
+        slot=slot,
+        src=src,
+        dst=dst,
+        valid=valid,
+        dload=dload,
+        drep=jnp.zeros((1, 1), dtype=jnp.int32),
+        dleader=jnp.ones((1, 1), dtype=jnp.int32),
+        dpnw=jnp.zeros((1, 1), dtype=jnp.float32),
+        dleader_nw_in=pl[..., PartMetric.NW_IN_LEADER],
+    )
+
+
+def gather_actions(batch: ActionBatch, *idx) -> ActionBatch:
+    """Pick concrete actions out of a broadcast grid by index arrays.
+
+    `idx` has one index array per grid axis; fields are broadcast (a view
+    under XLA) then gathered, so the full grid is never materialized.
+    """
+    shape = jnp.broadcast_shapes(*(f.shape for f in (batch.kind, batch.p, batch.slot, batch.src, batch.dst, batch.valid)))
+
+    def pick(field):
+        return jnp.broadcast_to(field, shape)[idx]
+
+    def pick_vec(field):  # trailing per-Resource axis
+        return jnp.broadcast_to(field, shape + (field.shape[-1],))[idx]
+
+    return ActionBatch(
+        kind=pick(batch.kind),
+        p=pick(batch.p),
+        slot=pick(batch.slot),
+        src=pick(batch.src),
+        dst=pick(batch.dst),
+        valid=pick(batch.valid),
+        dload=pick_vec(batch.dload),
+        drep=pick(batch.drep),
+        dleader=pick(batch.dleader),
+        dpnw=pick(batch.dpnw),
+        dleader_nw_in=pick(batch.dleader_nw_in),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class BalancingAction:
+    """Host-side rendering of one applied action, the analog of
+    cc/analyzer/BalancingAction.java:17 (for logs, REST responses, tests)."""
+
+    partition: int
+    slot: int
+    source_broker: int
+    destination_broker: int
+    kind: int  # KIND_MOVE | KIND_LEADERSHIP
+
+    @property
+    def action_type(self) -> str:
+        return (
+            "INTER_BROKER_REPLICA_MOVEMENT" if self.kind == KIND_MOVE else "LEADERSHIP_MOVEMENT"
+        )

@@ -1,0 +1,339 @@
+"""Optimizer context: static inputs and incrementally-updated aggregates.
+
+`StaticCtx` carries everything that is constant across an optimization run
+(the flattened cluster inputs, constraint thresholds, and the
+`OptimizationOptions` masks — cc/analyzer/OptimizationOptions.java:14 turned
+into boolean arrays). `Aggregates` carries the per-broker/per-rack/per-topic
+summaries the goals consult; they are recomputed from the assignment with
+segment-sums and updated incrementally inside the apply scan — the dense
+equivalent of the bookkeeping ClusterModel does inside relocateReplica /
+relocateLeadership (cc/model/ClusterModel.java:280,:307).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.common.resources import BrokerState, PartMetric, Resource
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
+from cruise_control_tpu.models.flat_model import FlatClusterModel
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizationOptions:
+    """Mask-encoded request options (cc/analyzer/OptimizationOptions.java:14)."""
+
+    #: replicas of these partitions may not be moved (excluded topics)
+    excluded_partitions: Optional[np.ndarray] = None  # bool[P]
+    #: these brokers may not *receive leadership*
+    excluded_brokers_for_leadership: Optional[np.ndarray] = None  # bool[B]
+    #: these brokers may not *receive replicas*
+    excluded_brokers_for_replica_move: Optional[np.ndarray] = None  # bool[B]
+    #: if set, only these brokers are valid destinations (add_broker mode)
+    requested_destination_brokers: Optional[np.ndarray] = None  # bool[B]
+    #: self-healing mode: only move replicas that sit on dead brokers
+    only_move_immigrants: bool = False
+    #: triggered by the goal-violation detector (relaxes distribution margins)
+    is_triggered_by_goal_violation: bool = False
+
+
+class StaticCtx(NamedTuple):
+    """Trace-time-constant arrays + python ints for an optimization run."""
+
+    part_load: jax.Array  # f32[P, M]
+    topic_id: jax.Array  # i32[P]
+    broker_capacity: jax.Array  # f32[B, 4]
+    capacity_limit: jax.Array  # f32[B, 4] capacity * capacity.threshold
+    broker_rack: jax.Array  # i32[B]
+    broker_host: jax.Array  # i32[B]
+    broker_state: jax.Array  # i32[B]
+    alive: jax.Array  # bool[B]
+    dead: jax.Array  # bool[B]
+    new: jax.Array  # bool[B]
+    demoted: jax.Array  # bool[B]
+    #: brokers eligible to receive a replica: alive & not excluded & dst filter
+    replica_dst_ok: jax.Array  # bool[B]
+    #: brokers eligible to receive leadership
+    leadership_dst_ok: jax.Array  # bool[B]
+    #: partitions whose replicas may move
+    movable_partition: jax.Array  # bool[P]
+    host_cpu_capacity_limit: jax.Array  # f32[H]
+    # constraint thresholds (from BalancingConstraint)
+    resource_balance_pct: jax.Array  # f32[4]
+    low_utilization_threshold: jax.Array  # f32[4]
+    replica_balance_pct: jax.Array  # f32[]
+    leader_replica_balance_pct: jax.Array  # f32[]
+    topic_replica_balance_pct: jax.Array  # f32[]
+    max_replicas_per_broker: jax.Array  # i32[]
+    only_move_immigrants: jax.Array  # bool[]
+
+
+class Aggregates(NamedTuple):
+    """Mutable (functionally-updated) summaries; pytree carried through scans."""
+
+    assignment: jax.Array  # i32[P, R]
+    broker_load: jax.Array  # f32[B, 4]
+    replica_count: jax.Array  # i32[B]
+    leader_count: jax.Array  # i32[B]
+    potential_nw_out: jax.Array  # f32[B]
+    leader_nw_in: jax.Array  # f32[B]
+    rack_replica_count: jax.Array  # i32[P, NR] replicas of p on each rack
+    topic_replica_count: jax.Array  # i32[T, B]
+    host_cpu_load: jax.Array  # f32[H]
+
+
+@dataclasses.dataclass(frozen=True)
+class Dims:
+    """Static (python int) problem dimensions, fixed at trace time."""
+
+    num_partitions: int
+    max_rf: int
+    num_brokers: int
+    num_racks: int
+    num_hosts: int
+    num_topics: int
+
+
+def dims_of(model: FlatClusterModel) -> Dims:
+    rack = np.asarray(model.broker_rack)
+    host = np.asarray(model.broker_host)
+    topic = np.asarray(model.topic_id)
+    return Dims(
+        num_partitions=model.num_partitions,
+        max_rf=model.max_replication_factor,
+        num_brokers=model.num_brokers,
+        num_racks=int(rack.max()) + 1 if rack.size else 0,
+        num_hosts=int(host.max()) + 1 if host.size else 0,
+        num_topics=int(topic.max()) + 1 if topic.size else 0,
+    )
+
+
+def build_static_ctx(
+    model: FlatClusterModel,
+    constraint: BalancingConstraint,
+    dims: Dims,
+    options: OptimizationOptions = OptimizationOptions(),
+) -> StaticCtx:
+    b = dims.num_brokers
+    state = jnp.asarray(model.broker_state)
+    alive = state != BrokerState.DEAD
+    demoted = state == BrokerState.DEMOTED
+
+    def mask_or(arr, default):
+        if arr is None:
+            return jnp.full((b,), default)
+        return jnp.asarray(arr, dtype=bool)
+
+    replica_dst_ok = alive & ~mask_or(options.excluded_brokers_for_replica_move, False)
+    if options.requested_destination_brokers is not None:
+        replica_dst_ok = replica_dst_ok & jnp.asarray(
+            options.requested_destination_brokers, dtype=bool
+        )
+    leadership_dst_ok = alive & ~demoted & ~mask_or(
+        options.excluded_brokers_for_leadership, False
+    )
+
+    if options.excluded_partitions is None:
+        movable = jnp.ones((dims.num_partitions,), dtype=bool)
+    else:
+        movable = ~jnp.asarray(options.excluded_partitions, dtype=bool)
+
+    effective = constraint
+    if options.is_triggered_by_goal_violation:
+        effective = constraint.with_multiplier_applied()
+
+    capacity = jnp.asarray(model.broker_capacity)
+    cap_threshold = jnp.asarray(effective.capacity_threshold)
+    capacity_limit = capacity * cap_threshold[None, :]
+    # CPU capacity is host-level (cc/common/Resource.java:18): a host's limit is
+    # the sum of its brokers' CPU capacities times the CPU threshold.
+    host_cpu_cap = jax.ops.segment_sum(
+        capacity[:, Resource.CPU], jnp.asarray(model.broker_host), num_segments=dims.num_hosts
+    )
+    return StaticCtx(
+        part_load=jnp.asarray(model.part_load),
+        topic_id=jnp.asarray(model.topic_id),
+        broker_capacity=capacity,
+        capacity_limit=capacity_limit,
+        broker_rack=jnp.asarray(model.broker_rack),
+        broker_host=jnp.asarray(model.broker_host),
+        broker_state=state,
+        alive=alive,
+        dead=~alive,
+        new=state == BrokerState.NEW,
+        demoted=demoted,
+        replica_dst_ok=replica_dst_ok,
+        leadership_dst_ok=leadership_dst_ok,
+        movable_partition=movable,
+        host_cpu_capacity_limit=host_cpu_cap * cap_threshold[Resource.CPU],
+        resource_balance_pct=jnp.asarray(effective.resource_balance_percentage),
+        low_utilization_threshold=jnp.asarray(effective.low_utilization_threshold),
+        replica_balance_pct=jnp.float32(effective.replica_balance_percentage),
+        leader_replica_balance_pct=jnp.float32(effective.leader_replica_balance_percentage),
+        topic_replica_balance_pct=jnp.float32(effective.topic_replica_balance_percentage),
+        max_replicas_per_broker=jnp.int32(effective.max_replicas_per_broker),
+        only_move_immigrants=jnp.asarray(options.only_move_immigrants),
+    )
+
+
+def compute_aggregates(static: StaticCtx, assignment: jax.Array, dims: Dims) -> Aggregates:
+    """Full recompute of all aggregates via segment-sums (round boundaries)."""
+    p, r = assignment.shape
+    b = dims.num_brokers
+    valid = assignment >= 0
+    seg = jnp.where(valid, assignment, b).reshape(p * r)
+
+    pl = static.part_load
+    lead_vec = jnp.stack(
+        [
+            pl[:, PartMetric.CPU_LEADER],
+            pl[:, PartMetric.NW_IN_LEADER],
+            pl[:, PartMetric.NW_OUT_LEADER],
+            pl[:, PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+    foll_vec = jnp.stack(
+        [
+            pl[:, PartMetric.CPU_FOLLOWER],
+            pl[:, PartMetric.NW_IN_FOLLOWER],
+            jnp.zeros_like(pl[:, 0]),
+            pl[:, PartMetric.DISK],
+        ],
+        axis=-1,
+    )
+    is_leader = (jnp.arange(r) == 0)[None, :, None]
+    contrib = jnp.where(is_leader, lead_vec[:, None, :], foll_vec[:, None, :])
+    broker_load = jax.ops.segment_sum(contrib.reshape(p * r, 4), seg, num_segments=b + 1)[:b]
+
+    ones = jnp.ones((p * r,), dtype=jnp.int32)
+    replica_count = jax.ops.segment_sum(ones, seg, num_segments=b + 1)[:b]
+
+    leader_seg = jnp.where(assignment[:, 0] >= 0, assignment[:, 0], b)
+    leader_count = jax.ops.segment_sum(
+        jnp.ones((p,), dtype=jnp.int32), leader_seg, num_segments=b + 1
+    )[:b]
+    leader_nw_in = jax.ops.segment_sum(
+        pl[:, PartMetric.NW_IN_LEADER], leader_seg, num_segments=b + 1
+    )[:b]
+
+    pnw_contrib = jnp.broadcast_to(pl[:, PartMetric.NW_OUT_LEADER, None], (p, r)).reshape(p * r)
+    potential = jax.ops.segment_sum(pnw_contrib, seg, num_segments=b + 1)[:b]
+
+    # replicas of partition p per rack: scatter-add into [P, NR+1]
+    nr = dims.num_racks
+    rack_of = jnp.where(valid, static.broker_rack[jnp.where(valid, assignment, 0)], nr)
+    p_idx = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32)[:, None], (p, r))
+    rack_flat = (p_idx * (nr + 1) + rack_of).reshape(p * r)
+    rack_replica_count = jax.ops.segment_sum(
+        ones, rack_flat, num_segments=p * (nr + 1)
+    ).reshape(p, nr + 1)[:, :nr]
+
+    t = dims.num_topics
+    topic = jnp.broadcast_to(static.topic_id[:, None], (p, r))
+    topic_flat = (topic * (b + 1) + jnp.where(valid, assignment, b)).reshape(p * r)
+    topic_replica_count = jax.ops.segment_sum(
+        ones, topic_flat, num_segments=t * (b + 1)
+    ).reshape(t, b + 1)[:, :b]
+
+    host_cpu = jax.ops.segment_sum(
+        broker_load[:, Resource.CPU], static.broker_host, num_segments=dims.num_hosts
+    )
+    return Aggregates(
+        assignment=assignment,
+        broker_load=broker_load,
+        replica_count=replica_count,
+        leader_count=leader_count,
+        potential_nw_out=potential,
+        leader_nw_in=leader_nw_in,
+        rack_replica_count=rack_replica_count,
+        topic_replica_count=topic_replica_count,
+        host_cpu_load=host_cpu,
+    )
+
+
+def apply_action(static: StaticCtx, agg: Aggregates, act: ActionBatch, apply_flag) -> Aggregates:
+    """Apply ONE action (scalar fields in `act`) to the aggregates.
+
+    Used inside the optimizer's sequential re-validated scan. `apply_flag` is a
+    traced bool; when False the update is the identity (masked no-op, keeping
+    the scan shape-static). Covers both action kinds with `where` masks — the
+    incremental counterpart of compute_aggregates.
+    """
+    is_move = act.kind == KIND_MOVE
+    p, slot, src, dst = act.p, act.slot, act.src, act.dst
+    w = apply_flag
+
+    # assignment: move sets (p, slot) = dst; leadership swaps slots 0 and slot.
+    a = agg.assignment
+    move_a = a.at[p, slot].set(jnp.where(w, dst, a[p, slot]))
+    old_leader = a[p, 0]
+    lead_a = a.at[p, 0].set(jnp.where(w, a[p, slot], a[p, 0]))
+    lead_a = lead_a.at[p, slot].set(jnp.where(w, old_leader, lead_a[p, slot]))
+    new_assignment = jnp.where(is_move, move_a, lead_a)
+
+    dload = act.dload * jnp.where(w, 1.0, 0.0)
+    broker_load = agg.broker_load.at[src].add(-dload).at[dst].add(dload)
+
+    dint = jnp.where(w, 1, 0)
+    drep = act.drep * dint
+    replica_count = agg.replica_count.at[src].add(-drep).at[dst].add(drep)
+    dlead = act.dleader * dint
+    leader_count = agg.leader_count.at[src].add(-dlead).at[dst].add(dlead)
+
+    dpnw = act.dpnw * jnp.where(w, 1.0, 0.0)
+    potential = agg.potential_nw_out.at[src].add(-dpnw).at[dst].add(dpnw)
+    dlnw = act.dleader_nw_in * jnp.where(w, 1.0, 0.0)
+    leader_nw_in = agg.leader_nw_in.at[src].add(-dlnw).at[dst].add(dlnw)
+
+    # rack / topic counts only change for replica moves
+    dmove = jnp.where(w & is_move, 1, 0)
+    rack_src = static.broker_rack[src]
+    rack_dst = static.broker_rack[dst]
+    rack_counts = (
+        agg.rack_replica_count.at[p, rack_src].add(-dmove).at[p, rack_dst].add(dmove)
+    )
+    topic = static.topic_id[p]
+    topic_counts = (
+        agg.topic_replica_count.at[topic, src].add(-dmove).at[topic, dst].add(dmove)
+    )
+
+    dcpu = dload[..., Resource.CPU]
+    host_cpu = (
+        agg.host_cpu_load.at[static.broker_host[src]]
+        .add(-dcpu)
+        .at[static.broker_host[dst]]
+        .add(dcpu)
+    )
+    return Aggregates(
+        assignment=new_assignment,
+        broker_load=broker_load,
+        replica_count=replica_count,
+        leader_count=leader_count,
+        potential_nw_out=potential,
+        leader_nw_in=leader_nw_in,
+        rack_replica_count=rack_counts,
+        topic_replica_count=topic_counts,
+        host_cpu_load=host_cpu,
+    )
+
+
+def utilization(agg: Aggregates, static: StaticCtx) -> jax.Array:
+    """f32[B, 4] load / capacity."""
+    return agg.broker_load / jnp.maximum(static.broker_capacity, 1e-9)
+
+
+def dst_hosts_partition(agg: Aggregates, p, dst) -> jax.Array:
+    """bool[...]: does dst already host a replica of p (any slot)?
+
+    The dense form of GoalUtils.legitMove's "destination must not contain the
+    partition" check (cc/analyzer/goals/GoalUtils.java).
+    """
+    row = agg.assignment[p]  # [..., R]
+    return jnp.any(row == dst[..., None], axis=-1)

@@ -1,0 +1,78 @@
+"""Goal registry: name -> singleton goal instance, in reference priority order.
+
+Mirrors the default goal stack of cc/config/KafkaCruiseControlConfig.java:1287-1322
+and the goal-name resolution in KafkaCruiseControl.goalsByPriority (:1218).
+Java class paths from a reference cruisecontrol.properties resolve by simple
+name, so operator configs carry over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from cruise_control_tpu.analyzer.goals.base import Goal
+from cruise_control_tpu.analyzer.goals.hard import (
+    CapacityGoal,
+    RackAwareGoal,
+    ReplicaCapacityGoal,
+)
+from cruise_control_tpu.analyzer.goals.preferred import elect_preferred_leaders
+from cruise_control_tpu.analyzer.goals.soft import (
+    LeaderBytesInDistributionGoal,
+    LeaderReplicaDistributionGoal,
+    PotentialNwOutGoal,
+    ReplicaDistributionGoal,
+    ResourceDistributionGoal,
+    TopicReplicaDistributionGoal,
+)
+from cruise_control_tpu.common.resources import Resource
+
+#: Priority-ordered default stack (same order as the reference's default.goals).
+DEFAULT_GOAL_ORDER: List[Goal] = [
+    RackAwareGoal(),
+    ReplicaCapacityGoal(),
+    CapacityGoal(Resource.DISK),
+    CapacityGoal(Resource.NW_IN),
+    CapacityGoal(Resource.NW_OUT),
+    CapacityGoal(Resource.CPU),
+    ReplicaDistributionGoal(),
+    PotentialNwOutGoal(),
+    ResourceDistributionGoal(Resource.DISK),
+    ResourceDistributionGoal(Resource.NW_IN),
+    ResourceDistributionGoal(Resource.NW_OUT),
+    ResourceDistributionGoal(Resource.CPU),
+    TopicReplicaDistributionGoal(),
+    LeaderReplicaDistributionGoal(),
+    LeaderBytesInDistributionGoal(),
+]
+
+GOAL_REGISTRY: Dict[str, Goal] = {g.name: g for g in DEFAULT_GOAL_ORDER}
+
+HARD_GOAL_NAMES = [g.name for g in DEFAULT_GOAL_ORDER if g.is_hard]
+
+
+def get_goal(name: str) -> Goal:
+    """Resolve a goal by simple or fully-qualified (Java or Python) name."""
+    simple = name.rsplit(".", 1)[-1]
+    if simple not in GOAL_REGISTRY:
+        raise KeyError(f"unknown goal: {name!r} (known: {sorted(GOAL_REGISTRY)})")
+    return GOAL_REGISTRY[simple]
+
+
+def goals_by_priority(names: Sequence[str] | None = None) -> List[Goal]:
+    """Requested goals in default-priority order; None = the full stack."""
+    if names is None:
+        return list(DEFAULT_GOAL_ORDER)
+    wanted = {get_goal(n).name for n in names}
+    return [g for g in DEFAULT_GOAL_ORDER if g.name in wanted]
+
+
+__all__ = [
+    "Goal",
+    "DEFAULT_GOAL_ORDER",
+    "GOAL_REGISTRY",
+    "HARD_GOAL_NAMES",
+    "get_goal",
+    "goals_by_priority",
+    "elect_preferred_leaders",
+]

@@ -1,0 +1,103 @@
+"""Goal SPI: each goal is a set of pure vectorized functions.
+
+The counterpart of the reference Goal interface (cc/analyzer/goals/Goal.java:38)
+and the greedy engine hooks of AbstractGoal (cc/analyzer/goals/AbstractGoal.java:42),
+re-expressed so every method evaluates a whole *batch* of candidate actions or
+all brokers at once:
+
+  prepare           ~ initGoalState: derive thresholds from current aggregates
+  broker_violation  ~ brokersToBalance / selfSatisfied, as a bool[B] mask
+  acceptance        ~ actionAcceptance, vectorized over an ActionBatch
+  action_score      ~ the improvement criterion the greedy loop implicitly
+                      pursues; > 0 only when the action makes this goal better
+  dst_preference    ~ the candidate-broker sort in GoalUtils.eligibleBrokers
+  cost              ~ clusterModelStatsComparator, as a scalar
+
+All methods must be jittable and shape-polymorphic over the action batch.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import ActionBatch
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, utilization
+
+#: Margin factor applied inside balance thresholds, matching the reference's
+#: BALANCE_MARGIN = 0.9 (cc/analyzer/goals/ResourceDistributionGoal.java and
+#: ReplicaDistributionAbstractGoal: the configured percentage is tightened by
+#: 10% so proposals keep headroom under the user-facing threshold).
+BALANCE_MARGIN = 0.9
+
+#: Minimum action score considered a real improvement (float32 noise floor).
+SCORE_EPS = 1e-6
+
+
+class Goal:
+    name: str = ""
+    is_hard: bool = False
+    #: include the replica-move candidate family when optimizing this goal
+    uses_moves: bool = True
+    #: include the leadership candidate family when optimizing this goal
+    uses_leadership: bool = False
+
+    def prepare(self, static: StaticCtx, agg: Aggregates, dims) -> Any:
+        """Per-goal threshold state derived from current aggregates."""
+        return None
+
+    def broker_violation(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
+        """bool[B]: alive brokers currently violating this goal."""
+        raise NotImplementedError
+
+    def cost(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
+        """Scalar >= 0; 0 iff the goal is fully satisfied."""
+        raise NotImplementedError
+
+    def acceptance(self, static: StaticCtx, gs, agg: Aggregates, act: ActionBatch) -> jax.Array:
+        """bool[...]: would this goal still hold (not get worse) after act?"""
+        raise NotImplementedError
+
+    def action_score(self, static: StaticCtx, gs, agg: Aggregates, act: ActionBatch) -> jax.Array:
+        """f32[...]: improvement of this goal from act; <= 0 when no help."""
+        raise NotImplementedError
+
+    def dst_preference(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
+        """f32[B]: higher = better destination candidate for this goal."""
+        util = utilization(agg, static)
+        return -jnp.max(util, axis=1)
+
+    def __repr__(self) -> str:  # goals are stateless singletons
+        return self.name
+
+
+def imbalance(value, lower, upper):
+    """Distance outside [lower, upper]; 0 inside."""
+    return jnp.maximum(0.0, value - upper) + jnp.maximum(0.0, lower - value)
+
+
+def balance_limits(avg, balance_pct):
+    """(lower, upper) around avg with the reference's margin tightening."""
+    margin = (balance_pct - 1.0) * BALANCE_MARGIN
+    upper = avg * (1.0 + margin)
+    lower = avg * jnp.maximum(0.0, 1.0 - margin)
+    return lower, upper
+
+
+def distribution_score(before_src, before_dst, after_src, after_dst, lower, upper,
+                       tiebreak=0.0):
+    """Imbalance reduction on the two touched brokers, with a bounded tiebreak.
+
+    Positive only when the action strictly reduces total out-of-range distance;
+    the tiebreak (scaled to stay below SCORE_EPS-relevant magnitudes) orders
+    equally-improving actions.
+    """
+    red = (
+        imbalance(before_src, lower, upper)
+        + imbalance(before_dst, lower, upper)
+        - imbalance(after_src, lower, upper)
+        - imbalance(after_dst, lower, upper)
+    )
+    return jnp.where(red > SCORE_EPS, red + 1e-3 * jnp.tanh(tiebreak), 0.0)

@@ -1,0 +1,166 @@
+"""Hard goals: rack awareness, replica capacity, resource capacity.
+
+Kernels mirroring the semantics of:
+  RackAwareGoal          cc/analyzer/goals/RackAwareGoal.java:40
+  ReplicaCapacityGoal    cc/analyzer/goals/ReplicaCapacityGoal.java:37
+  CapacityGoal + thin subclasses (Disk/NetworkIn/NetworkOut/Cpu)
+                         cc/analyzer/goals/CapacityGoal.java:39
+Each is a feasibility predicate plus a fixing score; CPU capacity is enforced
+at host level as well as broker level (cc/common/Resource.java:18,
+CapacityGoal host checks).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, utilization
+from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
+from cruise_control_tpu.common.resources import Resource
+
+
+class RackAwareGoal(Goal):
+    """No two replicas of a partition on the same rack."""
+
+    name = "RackAwareGoal"
+    is_hard = True
+    uses_moves = True
+    uses_leadership = False
+
+    def _slot_violation(self, static, agg):
+        """bool[P, R]: slot sits on a rack that hosts >1 replica of its partition."""
+        a = agg.assignment
+        valid = a >= 0
+        rack = static.broker_rack[jnp.where(valid, a, 0)]
+        count = jnp.take_along_axis(agg.rack_replica_count, rack, axis=1)
+        return valid & (count > 1)
+
+    def broker_violation(self, static, gs, agg):
+        slot_viol = self._slot_violation(static, agg)
+        b = static.alive.shape[0]
+        seg = jnp.where(agg.assignment >= 0, agg.assignment, b).reshape(-1)
+        viol = jax.ops.segment_max(
+            slot_viol.reshape(-1).astype(jnp.int32), seg, num_segments=b + 1
+        )[:b]
+        return (viol > 0) & static.alive
+
+    def cost(self, static, gs, agg):
+        return jnp.sum(self._slot_violation(static, agg).astype(jnp.float32))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        rack_src = static.broker_rack[act.src]
+        rack_dst = static.broker_rack[act.dst]
+        # replicas of p already on dst's rack, not counting the one moving away
+        count_dst = agg.rack_replica_count[act.p, rack_dst] - (rack_src == rack_dst)
+        return jnp.where(is_move, count_dst == 0, True)
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        # fixing score: the moving replica shares a rack with a sibling replica
+        rack_src = static.broker_rack[act.src]
+        dup = agg.rack_replica_count[act.p, rack_src] > 1
+        is_move = act.kind == KIND_MOVE
+        util = utilization(agg, static)
+        tiebreak = 1e-3 * (1.0 - jnp.tanh(jnp.max(util, axis=1)))[act.dst]
+        return jnp.where(is_move & dup, 1.0 + tiebreak, 0.0)
+
+
+class ReplicaCapacityGoal(Goal):
+    """Replica count per broker <= max.replicas.per.broker
+    (cc/analyzer/goals/ReplicaCapacityGoal.java:37)."""
+
+    name = "ReplicaCapacityGoal"
+    is_hard = True
+    uses_moves = True
+
+    def broker_violation(self, static, gs, agg):
+        return (agg.replica_count > static.max_replicas_per_broker) & static.alive
+
+    def cost(self, static, gs, agg):
+        over = jnp.maximum(0, agg.replica_count - static.max_replicas_per_broker)
+        return jnp.sum(jnp.where(static.alive, over, 0).astype(jnp.float32))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        fits = agg.replica_count[act.dst] + 1 <= static.max_replicas_per_broker
+        return jnp.where(is_move, fits, True)
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        over = agg.replica_count[act.src] > static.max_replicas_per_broker
+        headroom = (
+            static.max_replicas_per_broker - agg.replica_count[act.dst]
+        ).astype(jnp.float32)
+        return jnp.where(is_move & over, 1.0 + 1e-3 * jnp.tanh(headroom * 1e-3), 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -agg.replica_count.astype(jnp.float32)
+
+
+class CapacityGoalState(NamedTuple):
+    limit: jax.Array  # f32[B] usable capacity for this resource
+
+
+class CapacityGoal(Goal):
+    """Broker utilization of one resource <= capacity * capacity.threshold
+    (cc/analyzer/goals/CapacityGoal.java:39). For CPU the same bound is also
+    enforced against the host-level sum."""
+
+    is_hard = True
+
+    def __init__(self, resource: Resource):
+        self.resource = int(resource)
+        self.name = {
+            Resource.DISK: "DiskCapacityGoal",
+            Resource.NW_IN: "NetworkInboundCapacityGoal",
+            Resource.NW_OUT: "NetworkOutboundCapacityGoal",
+            Resource.CPU: "CpuCapacityGoal",
+        }[Resource(resource)]
+        # leadership shifts CPU and NW_OUT load, so those variants also propose
+        # leadership moves (CapacityGoal leadership path for NW_OUT/CPU)
+        self.uses_leadership = resource in (Resource.CPU, Resource.NW_OUT)
+
+    def prepare(self, static, agg, dims):
+        return CapacityGoalState(limit=static.capacity_limit[:, self.resource])
+
+    def _host_ok_after(self, static, agg, act, dres):
+        """CPU only: destination host stays under its limit."""
+        host_src = static.broker_host[act.src]
+        host_dst = static.broker_host[act.dst]
+        same_host = host_src == host_dst
+        after = agg.host_cpu_load[host_dst] + jnp.where(same_host, 0.0, dres)
+        return after <= static.host_cpu_capacity_limit[host_dst]
+
+    def broker_violation(self, static, gs, agg):
+        over = agg.broker_load[:, self.resource] > gs.limit
+        if self.resource == Resource.CPU:
+            host_over = agg.host_cpu_load > static.host_cpu_capacity_limit
+            over = over | host_over[static.broker_host]
+        return over & static.alive
+
+    def cost(self, static, gs, agg):
+        excess = jnp.maximum(0.0, agg.broker_load[:, self.resource] - gs.limit)
+        return jnp.sum(jnp.where(static.alive, excess, 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        dres = act.dload[..., self.resource]
+        after = agg.broker_load[act.dst, self.resource] + dres
+        ok = (after <= gs.limit[act.dst]) | (dres <= 0)
+        if self.resource == Resource.CPU:
+            ok = ok & (self._host_ok_after(static, agg, act, dres) | (dres <= 0))
+        return ok
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        dres = act.dload[..., self.resource]
+        src_over = agg.broker_load[act.src, self.resource] > gs.limit[act.src]
+        if self.resource == Resource.CPU:
+            host_over = agg.host_cpu_load > static.host_cpu_capacity_limit
+            src_over = src_over | host_over[static.broker_host[act.src]]
+        return jnp.where(src_over & (dres > SCORE_EPS), dres, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return gs.limit - agg.broker_load[:, self.resource]

@@ -1,0 +1,48 @@
+"""PreferredLeaderElectionGoal: leadership back to the preferred replica.
+
+The reference utility goal (cc/analyzer/goals/PreferredLeaderElectionGoal.java:33)
+makes replica position 0 the leader everywhere, skipping replicas on dead or
+demoted brokers; it is used by the demote flow
+(cc/KafkaCruiseControl.demoteBrokers:434-474). In the flat model slot order is
+the preference order and slot 0 is the leader, so the kernel promotes, for each
+partition whose leader sits on an excluded (demoted/dead) broker, the
+lowest-slot replica on an eligible broker — one vectorized swap pass instead
+of a greedy loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.context import StaticCtx
+
+
+def elect_preferred_leaders(static: StaticCtx, assignment: jax.Array) -> jax.Array:
+    """i32[P, R] -> i32[P, R]: move leadership off demoted/dead brokers.
+
+    For each partition whose slot-0 broker is demoted or dead, swap slot 0 with
+    the first slot whose broker is alive and not demoted. Partitions with no
+    eligible replica are left unchanged (the caller surfaces them as
+    optimization failures, mirroring the reference's warning path).
+    """
+    p, r = assignment.shape
+    valid = assignment >= 0
+    holder = jnp.where(valid, assignment, 0)
+    ineligible = static.demoted | static.dead
+    slot_ok = valid & ~ineligible[holder]  # bool[P, R]
+
+    leader_bad = ineligible[holder[:, 0]] & valid[:, 0]
+    # first eligible slot per partition (R is tiny, argmax over bool is exact)
+    best_slot = jnp.argmax(slot_ok, axis=1).astype(jnp.int32)
+    has_eligible = jnp.any(slot_ok, axis=1)
+    do_swap = leader_bad & has_eligible & (best_slot != 0)
+
+    rows = jnp.arange(p, dtype=jnp.int32)
+    old_leader = assignment[:, 0]
+    new_leader = assignment[rows, best_slot]
+    out = assignment.at[:, 0].set(jnp.where(do_swap, new_leader, old_leader))
+    out = out.at[rows, best_slot].set(
+        jnp.where(do_swap, old_leader, assignment[rows, best_slot])
+    )
+    return out

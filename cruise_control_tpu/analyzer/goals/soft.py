@@ -1,0 +1,324 @@
+"""Soft goals: distribution balancing and potential-load guards.
+
+Kernels with the semantics of:
+  ReplicaDistributionGoal          cc/analyzer/goals/ReplicaDistributionGoal.java
+  ResourceDistributionGoal x4      cc/analyzer/goals/ResourceDistributionGoal.java:53
+  TopicReplicaDistributionGoal     cc/analyzer/goals/TopicReplicaDistributionGoal.java:53
+  LeaderReplicaDistributionGoal    cc/analyzer/goals/LeaderReplicaDistributionGoal.java
+  LeaderBytesInDistributionGoal    cc/analyzer/goals/LeaderBytesInDistributionGoal.java:39
+  PotentialNwOutGoal               cc/analyzer/goals/PotentialNwOutGoal.java:40
+
+Each computes its balance window from current aggregates (the analog of
+initGoalState), flags out-of-window brokers, and scores candidate actions by
+how much out-of-window distance they remove. Swap actions from the reference's
+rebalanceBySwapping* search are expressed by successive move pairs across
+rounds rather than a third action kind.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, utilization
+from cruise_control_tpu.analyzer.goals.base import (
+    SCORE_EPS,
+    Goal,
+    balance_limits,
+    distribution_score,
+    imbalance,
+)
+from cruise_control_tpu.common.resources import Resource
+
+
+class WindowState(NamedTuple):
+    lower: jax.Array  # f32[] balance window lower bound
+    upper: jax.Array  # f32[]
+    active: jax.Array  # bool[] goal participates (not a low-utilization cluster)
+
+
+class ResourceDistributionGoal(Goal):
+    """Per-broker utilization of one resource within [avg*lo, avg*hi]."""
+
+    is_hard = False
+
+    def __init__(self, resource: Resource):
+        self.resource = int(resource)
+        self.name = {
+            Resource.DISK: "DiskUsageDistributionGoal",
+            Resource.NW_IN: "NetworkInboundUsageDistributionGoal",
+            Resource.NW_OUT: "NetworkOutboundUsageDistributionGoal",
+            Resource.CPU: "CpuUsageDistributionGoal",
+        }[Resource(resource)]
+        self.uses_leadership = resource in (Resource.CPU, Resource.NW_OUT)
+
+    def prepare(self, static, agg, dims):
+        res = self.resource
+        total_cap = jnp.sum(jnp.where(static.alive, static.broker_capacity[:, res], 0.0))
+        avg = jnp.sum(agg.broker_load[:, res]) / jnp.maximum(total_cap, 1e-9)
+        lower, upper = balance_limits(avg, static.resource_balance_pct[res])
+        # low-utilization clusters are left alone
+        # (ResourceDistributionGoal low.utilization.threshold semantics)
+        active = avg >= static.low_utilization_threshold[res]
+        return WindowState(lower=lower, upper=upper, active=active)
+
+    def _util(self, static, agg):
+        return agg.broker_load[:, self.resource] / jnp.maximum(
+            static.broker_capacity[:, self.resource], 1e-9
+        )
+
+    def broker_violation(self, static, gs, agg):
+        u = self._util(static, agg)
+        out = (u > gs.upper) | (u < gs.lower)
+        return out & static.alive & gs.active
+
+    def cost(self, static, gs, agg):
+        u = self._util(static, agg)
+        dist = imbalance(u, gs.lower, gs.upper)
+        return jnp.where(gs.active, jnp.sum(jnp.where(static.alive, dist, 0.0)), 0.0)
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        res = self.resource
+        dres = act.dload[..., res]
+        cap_src = jnp.maximum(static.broker_capacity[act.src, res], 1e-9)
+        cap_dst = jnp.maximum(static.broker_capacity[act.dst, res], 1e-9)
+        u_src_after = (agg.broker_load[act.src, res] - dres) / cap_src
+        u_dst_after = (agg.broker_load[act.dst, res] + dres) / cap_dst
+        # source-side lower bound is waived for dead sources (self-healing) —
+        # load must leave dead brokers no matter what.
+        src_ok = (u_src_after >= gs.lower) | static.dead[act.src]
+        dst_ok = u_dst_after <= gs.upper
+        relevant = jnp.abs(dres) > 0.0
+        return ~gs.active | ~relevant | (src_ok & dst_ok)
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        res = self.resource
+        dres = act.dload[..., res]
+        cap_src = jnp.maximum(static.broker_capacity[act.src, res], 1e-9)
+        cap_dst = jnp.maximum(static.broker_capacity[act.dst, res], 1e-9)
+        u_src = agg.broker_load[act.src, res] / cap_src
+        u_dst = agg.broker_load[act.dst, res] / cap_dst
+        u_src_after = u_src - dres / cap_src
+        u_dst_after = u_dst + dres / cap_dst
+        score = distribution_score(
+            u_src, u_dst, u_src_after, u_dst_after, gs.lower, gs.upper,
+            tiebreak=(u_src - u_dst),
+        )
+        return jnp.where(gs.active, score, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -self._util(static, agg)
+
+
+class ReplicaDistributionGoal(Goal):
+    """Replica count per broker within the balance window around the mean
+    (cc/analyzer/goals/ReplicaDistributionGoal.java, base
+    ReplicaDistributionAbstractGoal.java:27)."""
+
+    name = "ReplicaDistributionGoal"
+
+    def prepare(self, static, agg, dims):
+        n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
+        avg = jnp.sum(agg.replica_count).astype(jnp.float32) / n_alive
+        lower, upper = balance_limits(avg, static.replica_balance_pct)
+        return WindowState(lower=jnp.floor(lower), upper=jnp.ceil(upper),
+                           active=jnp.asarray(True))
+
+    def broker_violation(self, static, gs, agg):
+        c = agg.replica_count.astype(jnp.float32)
+        return ((c > gs.upper) | (c < gs.lower)) & static.alive
+
+    def cost(self, static, gs, agg):
+        c = agg.replica_count.astype(jnp.float32)
+        return jnp.sum(jnp.where(static.alive, imbalance(c, gs.lower, gs.upper), 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        src_after = (agg.replica_count[act.src] - 1).astype(jnp.float32)
+        dst_after = (agg.replica_count[act.dst] + 1).astype(jnp.float32)
+        ok = ((src_after >= gs.lower) | static.dead[act.src]) & (dst_after <= gs.upper)
+        return ~is_move | ok
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        c_src = agg.replica_count[act.src].astype(jnp.float32)
+        c_dst = agg.replica_count[act.dst].astype(jnp.float32)
+        score = distribution_score(
+            c_src, c_dst, c_src - 1.0, c_dst + 1.0, gs.lower, gs.upper,
+            tiebreak=(c_src - c_dst) * 1e-2,
+        )
+        return jnp.where(is_move, score, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -agg.replica_count.astype(jnp.float32)
+
+
+class LeaderReplicaDistributionGoal(Goal):
+    """Leader count per broker within the balance window
+    (cc/analyzer/goals/LeaderReplicaDistributionGoal.java)."""
+
+    name = "LeaderReplicaDistributionGoal"
+    uses_leadership = True
+
+    def prepare(self, static, agg, dims):
+        n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
+        avg = jnp.sum(agg.leader_count).astype(jnp.float32) / n_alive
+        lower, upper = balance_limits(avg, static.leader_replica_balance_pct)
+        return WindowState(lower=jnp.floor(lower), upper=jnp.ceil(upper),
+                           active=jnp.asarray(True))
+
+    def broker_violation(self, static, gs, agg):
+        c = agg.leader_count.astype(jnp.float32)
+        return ((c > gs.upper) | (c < gs.lower)) & static.alive
+
+    def cost(self, static, gs, agg):
+        c = agg.leader_count.astype(jnp.float32)
+        return jnp.sum(jnp.where(static.alive, imbalance(c, gs.lower, gs.upper), 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        transfers = act.dleader > 0
+        src_after = (agg.leader_count[act.src] - 1).astype(jnp.float32)
+        dst_after = (agg.leader_count[act.dst] + 1).astype(jnp.float32)
+        ok = ((src_after >= gs.lower) | static.dead[act.src]) & (dst_after <= gs.upper)
+        return ~transfers | ok
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        transfers = act.dleader > 0
+        c_src = agg.leader_count[act.src].astype(jnp.float32)
+        c_dst = agg.leader_count[act.dst].astype(jnp.float32)
+        score = distribution_score(
+            c_src, c_dst, c_src - 1.0, c_dst + 1.0, gs.lower, gs.upper,
+            tiebreak=(c_src - c_dst) * 1e-2,
+        )
+        return jnp.where(transfers, score, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -agg.leader_count.astype(jnp.float32)
+
+
+class TopicWindowState(NamedTuple):
+    lower: jax.Array  # f32[T]
+    upper: jax.Array  # f32[T]
+
+
+class TopicReplicaDistributionGoal(Goal):
+    """Per-topic replicas spread evenly across brokers
+    (cc/analyzer/goals/TopicReplicaDistributionGoal.java:53)."""
+
+    name = "TopicReplicaDistributionGoal"
+
+    def prepare(self, static, agg, dims):
+        n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
+        per_topic = jnp.sum(agg.topic_replica_count, axis=1).astype(jnp.float32)
+        avg = per_topic / n_alive  # f32[T]
+        lower, upper = balance_limits(avg, static.topic_replica_balance_pct)
+        return TopicWindowState(lower=jnp.floor(lower), upper=jnp.ceil(upper))
+
+    def broker_violation(self, static, gs, agg):
+        c = agg.topic_replica_count.astype(jnp.float32)  # [T, B]
+        out = (c > gs.upper[:, None]) | (c < gs.lower[:, None])
+        return jnp.any(out, axis=0) & static.alive
+
+    def cost(self, static, gs, agg):
+        c = agg.topic_replica_count.astype(jnp.float32)
+        dist = imbalance(c, gs.lower[:, None], gs.upper[:, None])
+        return jnp.sum(jnp.where(static.alive[None, :], dist, 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        t = static.topic_id[act.p]
+        src_after = (agg.topic_replica_count[t, act.src] - 1).astype(jnp.float32)
+        dst_after = (agg.topic_replica_count[t, act.dst] + 1).astype(jnp.float32)
+        ok = ((src_after >= gs.lower[t]) | static.dead[act.src]) & (dst_after <= gs.upper[t])
+        return ~is_move | ok
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        is_move = act.kind == KIND_MOVE
+        t = static.topic_id[act.p]
+        c_src = agg.topic_replica_count[t, act.src].astype(jnp.float32)
+        c_dst = agg.topic_replica_count[t, act.dst].astype(jnp.float32)
+        score = distribution_score(
+            c_src, c_dst, c_src - 1.0, c_dst + 1.0, gs.lower[t], gs.upper[t],
+            tiebreak=(c_src - c_dst) * 1e-2,
+        )
+        return jnp.where(is_move, score, 0.0)
+
+
+class PotentialNwOutGoal(Goal):
+    """Even if every replica on a broker became leader, its NW_OUT stays under
+    the capacity threshold (cc/analyzer/goals/PotentialNwOutGoal.java:35-40)."""
+
+    name = "PotentialNwOutGoal"
+
+    def prepare(self, static, agg, dims):
+        return WindowState(
+            lower=jnp.float32(0.0),
+            upper=jnp.float32(0.0),  # unused; limit is per-broker capacity
+            active=jnp.asarray(True),
+        )
+
+    def _limit(self, static):
+        return static.capacity_limit[:, Resource.NW_OUT]
+
+    def broker_violation(self, static, gs, agg):
+        return (agg.potential_nw_out > self._limit(static)) & static.alive
+
+    def cost(self, static, gs, agg):
+        excess = jnp.maximum(0.0, agg.potential_nw_out - self._limit(static))
+        return jnp.sum(jnp.where(static.alive, excess, 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        after = agg.potential_nw_out[act.dst] + act.dpnw
+        return (act.dpnw <= 0.0) | (after <= self._limit(static)[act.dst])
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        src_over = agg.potential_nw_out[act.src] > self._limit(static)[act.src]
+        return jnp.where(src_over & (act.dpnw > SCORE_EPS), act.dpnw, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return self._limit(static) - agg.potential_nw_out
+
+
+class LeaderBytesInDistributionGoal(Goal):
+    """Leader bytes-in per broker near the cluster mean
+    (cc/analyzer/goals/LeaderBytesInDistributionGoal.java:39)."""
+
+    name = "LeaderBytesInDistributionGoal"
+    uses_leadership = True
+
+    def prepare(self, static, agg, dims):
+        n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
+        mean = jnp.sum(agg.leader_nw_in) / n_alive
+        lower, upper = balance_limits(mean, static.resource_balance_pct[Resource.NW_IN])
+        # only the upper bound matters: the goal caps hot leaders
+        # (LeaderBytesInDistributionGoal balances by moving leadership off
+        # brokers above the mean; brokers below the mean are fine).
+        return WindowState(lower=jnp.float32(0.0), upper=upper, active=jnp.asarray(True))
+
+    def broker_violation(self, static, gs, agg):
+        return (agg.leader_nw_in > gs.upper) & static.alive
+
+    def cost(self, static, gs, agg):
+        excess = jnp.maximum(0.0, agg.leader_nw_in - gs.upper)
+        return jnp.sum(jnp.where(static.alive, excess, 0.0))
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        transfers = act.dleader_nw_in > 0.0
+        after = agg.leader_nw_in[act.dst] + act.dleader_nw_in
+        return ~transfers | (after <= gs.upper) | static.dead[act.src]
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        b_src = agg.leader_nw_in[act.src]
+        b_dst = agg.leader_nw_in[act.dst]
+        d = act.dleader_nw_in
+        score = distribution_score(
+            b_src, b_dst, b_src - d, b_dst + d, gs.lower, gs.upper,
+            tiebreak=(b_src - b_dst) * 1e-6,
+        )
+        return jnp.where(d > 0.0, score, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -agg.leader_nw_in

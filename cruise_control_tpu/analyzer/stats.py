@@ -1,0 +1,150 @@
+"""Cluster model statistics kernels.
+
+The analog of ClusterModelStats (cc/model/ClusterModelStats.java:22): per-
+resource utilization mean / standard deviation / min / max over alive brokers,
+replica / leader / topic-replica count statistics, and potential NW_OUT —
+computed as one fused jitted kernel over the FlatClusterModel instead of the
+reference's per-broker object walks. Used by the optimizer's per-goal
+comparator (AbstractGoal's stats regression check) and by the /load and
+proposal-summary responses.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.common.resources import Resource
+from cruise_control_tpu.models.flat_model import (
+    FlatClusterModel,
+    alive_broker_mask,
+    broker_loads,
+    leader_counts,
+    potential_nw_out,
+    replica_counts,
+    topic_replica_counts,
+)
+
+
+class ClusterModelStats(NamedTuple):
+    """Per-cluster summary statistics, all over *alive* brokers only
+    (matching ClusterModelStats.populate which skips dead brokers)."""
+
+    # f32[4] each, indexed by Resource
+    resource_mean: jax.Array
+    resource_std: jax.Array
+    resource_min: jax.Array
+    resource_max: jax.Array
+    # replica count stats, f32[] each
+    replica_mean: jax.Array
+    replica_std: jax.Array
+    replica_min: jax.Array
+    replica_max: jax.Array
+    # leader replica count stats
+    leader_mean: jax.Array
+    leader_std: jax.Array
+    # topic-replica spread: mean over topics of per-topic stddev across brokers
+    topic_replica_std: jax.Array
+    # potential nw out stats
+    potential_nw_out_mean: jax.Array
+    potential_nw_out_max: jax.Array
+    num_alive_brokers: jax.Array
+    num_replicas: jax.Array
+    num_leaders: jax.Array
+
+
+def _masked_stats(values: jax.Array, mask: jax.Array):
+    """(mean, std, min, max) of `values` where mask, as f32 scalars."""
+    v = values.astype(jnp.float32)
+    n = jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+    mean = jnp.sum(jnp.where(mask, v, 0.0)) / n
+    var = jnp.sum(jnp.where(mask, (v - mean) ** 2, 0.0)) / n
+    vmin = jnp.min(jnp.where(mask, v, jnp.inf))
+    vmax = jnp.max(jnp.where(mask, v, -jnp.inf))
+    return mean, jnp.sqrt(var), vmin, vmax
+
+
+def compute_stats(model: FlatClusterModel, num_topics: int) -> ClusterModelStats:
+    """Fused statistics kernel. `num_topics` must be static (trace-time)."""
+    alive = alive_broker_mask(model)
+    loads = broker_loads(model)  # f32[B, 4]
+    util = loads / jnp.maximum(model.broker_capacity, 1e-9)
+
+    means, stds, mins, maxs = [], [], [], []
+    for res in Resource:
+        m, s, lo, hi = _masked_stats(util[:, res], alive)
+        means.append(m)
+        stds.append(s)
+        mins.append(lo)
+        maxs.append(hi)
+
+    replicas = replica_counts(model)
+    leaders = leader_counts(model)
+    r_mean, r_std, r_min, r_max = _masked_stats(replicas, alive)
+    l_mean, l_std, _, _ = _masked_stats(leaders, alive)
+
+    # per-topic replica spread across alive brokers
+    t_counts = topic_replica_counts(model, num_topics).astype(jnp.float32)  # [T, B]
+    alive_f = alive.astype(jnp.float32)[None, :]
+    n_alive = jnp.maximum(jnp.sum(alive_f, axis=1), 1.0)
+    t_mean = jnp.sum(t_counts * alive_f, axis=1, keepdims=True) / n_alive[:, None]
+    t_var = jnp.sum(jnp.where(alive_f > 0, (t_counts - t_mean) ** 2, 0.0), axis=1) / n_alive
+    topic_std = jnp.mean(jnp.sqrt(t_var))
+
+    pnw = potential_nw_out(model)
+    p_mean, _, _, p_max = _masked_stats(pnw, alive)
+
+    return ClusterModelStats(
+        resource_mean=jnp.stack(means),
+        resource_std=jnp.stack(stds),
+        resource_min=jnp.stack(mins),
+        resource_max=jnp.stack(maxs),
+        replica_mean=r_mean,
+        replica_std=r_std,
+        replica_min=r_min,
+        replica_max=r_max,
+        leader_mean=l_mean,
+        leader_std=l_std,
+        topic_replica_std=topic_std,
+        potential_nw_out_mean=p_mean,
+        potential_nw_out_max=p_max,
+        num_alive_brokers=jnp.sum(alive.astype(jnp.int32)),
+        num_replicas=jnp.sum(replicas),
+        num_leaders=jnp.sum(leaders),
+    )
+
+
+def stats_to_dict(stats: ClusterModelStats) -> dict:
+    """Host-side JSON-friendly rendering (servlet response stats analog)."""
+    import numpy as np
+
+    res_names = [r.name for r in Resource]
+    out = {
+        "resources": {
+            name: {
+                "mean": float(np.asarray(stats.resource_mean)[i]),
+                "std": float(np.asarray(stats.resource_std)[i]),
+                "min": float(np.asarray(stats.resource_min)[i]),
+                "max": float(np.asarray(stats.resource_max)[i]),
+            }
+            for i, name in enumerate(res_names)
+        },
+        "replicas": {
+            "mean": float(stats.replica_mean),
+            "std": float(stats.replica_std),
+            "min": float(stats.replica_min),
+            "max": float(stats.replica_max),
+        },
+        "leaderReplicas": {"mean": float(stats.leader_mean), "std": float(stats.leader_std)},
+        "topicReplicasStd": float(stats.topic_replica_std),
+        "potentialNwOut": {
+            "mean": float(stats.potential_nw_out_mean),
+            "max": float(stats.potential_nw_out_max),
+        },
+        "numAliveBrokers": int(stats.num_alive_brokers),
+        "numReplicas": int(stats.num_replicas),
+        "numLeaders": int(stats.num_leaders),
+    }
+    return out

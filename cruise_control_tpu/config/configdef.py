@@ -183,14 +183,19 @@ def load_properties(path: str) -> Dict[str, str]:
             if line.endswith("\\"):
                 pending = line[:-1]
                 continue
-            for sep in ("=", ":"):
-                idx = _unescaped_index(line, sep)
-                if idx >= 0:
-                    props[line[:idx].strip()] = line[idx + 1 :].strip()
-                    break
-            else:
-                props[line.strip()] = ""
+            _store_property(props, line)
+        if pending:
+            _store_property(props, pending)
     return props
+
+
+def _store_property(props: Dict[str, str], line: str) -> None:
+    for sep in ("=", ":"):
+        idx = _unescaped_index(line, sep)
+        if idx >= 0:
+            props[line[:idx].strip()] = line[idx + 1 :].strip()
+            return
+    props[line.strip()] = ""
 
 
 def _unescaped_index(line: str, sep: str) -> int:
@@ -249,7 +254,9 @@ class AbstractConfig:
         return list(value) if value is not None else []
 
     def unused(self) -> List[str]:
-        return sorted(set(self._originals) - self._used - set(self._values))
+        """Supplied keys never read through an accessor (Kafka AbstractConfig
+        semantics: originals minus used, regardless of being defined)."""
+        return sorted(set(self._originals) - self._used)
 
     def get_configured_instance(self, name: str, expected_type: type):
         """Instantiate the class named by config key `name` and configure it."""

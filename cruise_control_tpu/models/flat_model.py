@@ -65,9 +65,13 @@ class FlatClusterModel(NamedTuple):
         return int(np.asarray(self.topic_id).max()) + 1 if self.topic_id.shape[0] else 0
 
 
-@dataclasses.dataclass(frozen=True)
+@dataclasses.dataclass(frozen=True, eq=False)
 class ClusterMetadata:
-    """Host-side naming metadata kept out of the jitted pytree."""
+    """Host-side naming metadata kept out of the jitted pytree.
+
+    eq=False: ndarray fields make the generated __eq__ ambiguous; identity
+    comparison is the meaningful one for a metadata handle.
+    """
 
     topic_names: tuple
     partition_index: np.ndarray  # i32[P] partition number within its topic

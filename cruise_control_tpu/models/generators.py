@@ -249,12 +249,21 @@ def random_cluster(
 def metadata_for(model: FlatClusterModel) -> ClusterMetadata:
     """Default naming metadata for generated models."""
     topic_ids = np.asarray(model.topic_id)
-    num_topics = int(topic_ids.max()) + 1 if topic_ids.size else 0
-    # partition index within its topic; topic_id arrives as grouped runs
-    # (np.repeat), so a cumulative count per run is a vectorized expression.
-    counts = np.bincount(topic_ids, minlength=num_topics)
-    starts = np.cumsum(counts) - counts
-    part_index = (np.arange(topic_ids.shape[0]) - np.repeat(starts, counts)).astype(np.int32)
+    num_topics = model.num_topics
+    # partition index within its topic, in file order (works for any topic-id
+    # ordering, grouped or interleaved): stable-sort by topic, rank within the
+    # run, scatter the ranks back.
+    n = topic_ids.shape[0]
+    order = np.argsort(topic_ids, kind="stable")
+    sorted_ids = topic_ids[order]
+    if n:
+        _, first_idx = np.unique(sorted_ids, return_index=True)
+        run_id = np.cumsum(np.r_[0, sorted_ids[1:] != sorted_ids[:-1]])
+        rank_in_run = np.arange(n) - first_idx[run_id]
+    else:
+        rank_in_run = np.zeros(0, dtype=np.int64)
+    part_index = np.empty(n, dtype=np.int32)
+    part_index[order] = rank_in_run.astype(np.int32)
     return ClusterMetadata(
         topic_names=tuple(f"topic-{t}" for t in range(num_topics)),
         partition_index=part_index,

@@ -1,0 +1,180 @@
+"""Optimizer tests: the analog of the reference's OptimizationVerifier harness
+(cct/analyzer/OptimizationVerifier.java:48) + DeterministicClusterTest — run a
+goal list on a fixture model, then assert post-conditions: hard goals hold, no
+replicas on dead brokers, distribution costs shrink, model invariants hold."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import (
+    OptimizationOptions,
+    build_static_ctx,
+    compute_aggregates,
+    dims_of,
+)
+from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY, goals_by_priority
+from cruise_control_tpu.analyzer.optimizer import (
+    GoalOptimizer,
+    OptimizerSettings,
+)
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.models import generators
+from cruise_control_tpu.models.flat_model import sanity_check
+
+
+def _violations(model, goal_names=None):
+    """{goal name: violated broker count} for the current placement."""
+    dims = dims_of(model)
+    static = build_static_ctx(model, BalancingConstraint.default(), dims)
+    agg = compute_aggregates(static, np.asarray(model.assignment), dims)
+    out = {}
+    for goal in goals_by_priority(goal_names):
+        gs = goal.prepare(static, agg, dims)
+        out[goal.name] = int(np.sum(np.asarray(goal.broker_violation(static, gs, agg))))
+    return out
+
+
+def _apply_proposals(init_assignment, proposals):
+    """Replay proposals onto the initial placement; must equal the final one."""
+    a = np.asarray(init_assignment).copy()
+    for pr in proposals:
+        row = np.full(a.shape[1], -1, dtype=a.dtype)
+        row[: len(pr.new_replicas)] = pr.new_replicas
+        a[pr.partition] = row
+    return a
+
+
+class TestRackAwareSlice:
+    def test_fixes_rack_violation(self):
+        model = generators.rack_aware_violated()
+        assert _violations(model, ["RackAwareGoal"])["RackAwareGoal"] > 0
+        result = GoalOptimizer().optimizations(model, ["RackAwareGoal"])
+        fixed = model._replace(assignment=result.final_assignment)
+        sanity_check(fixed)
+        assert _violations(fixed, ["RackAwareGoal"])["RackAwareGoal"] == 0
+        assert result.proposals, "fixing a violation must emit proposals"
+
+    def test_noop_when_satisfied(self):
+        model = generators.unbalanced()  # rack-aware is satisfiable there
+        result = GoalOptimizer().optimizations(model, ["RackAwareGoal"])
+        assert result.proposals == []
+        assert result.goal_results[0].rounds == 1  # one no-progress round
+
+
+class TestCapacitySlice:
+    def test_fixes_nw_in_capacity(self):
+        model = generators.capacity_violated()
+        before = _violations(model, ["NetworkInboundCapacityGoal"])
+        assert before["NetworkInboundCapacityGoal"] > 0
+        result = GoalOptimizer().optimizations(
+            model, ["RackAwareGoal", "NetworkInboundCapacityGoal"]
+        )
+        fixed = model._replace(assignment=result.final_assignment)
+        sanity_check(fixed)
+        assert _violations(fixed, ["NetworkInboundCapacityGoal"])[
+            "NetworkInboundCapacityGoal"
+        ] == 0
+
+    def test_replica_capacity(self):
+        model = generators.unbalanced()
+        constraint = BalancingConstraint.default()
+        constraint = type(constraint)(
+            resource_balance_percentage=constraint.resource_balance_percentage,
+            capacity_threshold=constraint.capacity_threshold,
+            low_utilization_threshold=constraint.low_utilization_threshold,
+            max_replicas_per_broker=3,
+        )
+        result = GoalOptimizer(constraint=constraint).optimizations(
+            model, ["ReplicaCapacityGoal"]
+        )
+        fixed = model._replace(assignment=result.final_assignment)
+        counts = np.bincount(
+            fixed.assignment[fixed.assignment >= 0], minlength=model.num_brokers
+        )
+        assert counts.max() <= 3
+
+
+class TestSelfHealing:
+    def test_dead_broker_evacuation(self):
+        model = generators.dead_broker_model()
+        result = GoalOptimizer().optimizations(
+            model, ["RackAwareGoal", "ReplicaCapacityGoal"]
+        )
+        final = result.final_assignment
+        dead = np.asarray(model.broker_state) == 3  # BrokerState.DEAD
+        dead_ids = np.nonzero(dead)[0]
+        assert not np.isin(final[final >= 0], dead_ids).any(), (
+            "no replica may remain on a dead broker"
+        )
+        sanity_check(model._replace(assignment=final))
+
+
+class TestFullStack:
+    @pytest.fixture(scope="class")
+    def random_model(self):
+        prop = generators.ClusterProperty(
+            num_racks=4, num_brokers=12, num_topics=20,
+            mean_partitions_per_topic=8.0, replication_factor=2,
+            load_distribution="exponential", mean_utilization=0.4,
+        )
+        return generators.random_cluster(seed=7, prop=prop)
+
+    def test_full_goal_stack(self, random_model):
+        result = GoalOptimizer().optimizations(random_model)
+        fixed = random_model._replace(assignment=result.final_assignment)
+        sanity_check(fixed)
+        after = _violations(fixed)
+        for name, goal in GOAL_REGISTRY.items():
+            if goal.is_hard:
+                assert after[name] == 0, f"hard goal {name} violated after optimize"
+        # soft goals must not get worse
+        for g in result.goal_results:
+            assert g.cost_after <= g.cost_before + 1e-4, g.name
+
+    def test_proposals_replay_to_final_assignment(self, random_model):
+        result = GoalOptimizer().optimizations(random_model)
+        replayed = _apply_proposals(random_model.assignment, result.proposals)
+        final_sets = [set(r[r >= 0]) for r in result.final_assignment]
+        replay_sets = [set(r[r >= 0]) for r in replayed]
+        assert final_sets == replay_sets
+        # leaders must match as well
+        assert (replayed[:, 0] == result.final_assignment[:, 0]).all()
+
+    def test_faithful_greedy_mode(self, random_model):
+        """batch_k=1 is the parity mode: one action per round."""
+        settings = OptimizerSettings(batch_k=1, max_rounds_per_goal=200)
+        result = GoalOptimizer(settings=settings).optimizations(
+            random_model, ["RackAwareGoal", "ReplicaCapacityGoal", "ReplicaDistributionGoal"]
+        )
+        fixed = random_model._replace(assignment=result.final_assignment)
+        sanity_check(fixed)
+        assert _violations(fixed, ["ReplicaDistributionGoal"])[
+            "ReplicaDistributionGoal"
+        ] == 0
+
+
+class TestOptions:
+    def test_excluded_partitions_never_move(self):
+        model = generators.capacity_violated()
+        excluded = np.zeros(model.num_partitions, dtype=bool)
+        excluded[:] = True  # nothing may move
+        result = GoalOptimizer().optimizations(
+            model,
+            ["NetworkInboundCapacityGoal"],
+            options=OptimizationOptions(excluded_partitions=excluded),
+            raise_on_hard_failure=False,
+        )
+        assert result.proposals == []
+
+    def test_destination_filter(self):
+        model = generators.capacity_violated()
+        requested = np.zeros(model.num_brokers, dtype=bool)
+        requested[3] = True  # only broker 3 may receive replicas
+        result = GoalOptimizer().optimizations(
+            model,
+            ["NetworkInboundCapacityGoal"],
+            options=OptimizationOptions(requested_destination_brokers=requested),
+            raise_on_hard_failure=False,
+        )
+        for pr in result.proposals:
+            assert set(pr.replicas_to_add) <= {3}

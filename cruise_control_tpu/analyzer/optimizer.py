@@ -68,6 +68,11 @@ class OptimizationFailureException(Exception):
     com.linkedin.kafka.cruisecontrol.exception.OptimizationFailureException)."""
 
 
+#: Module-level so the compile cache survives across optimizations() calls
+#: (the production regime: the precompute loop reuses compiled kernels).
+_jit_compute_stats = jax.jit(compute_stats, static_argnums=1)
+
+
 @dataclasses.dataclass(frozen=True)
 class OptimizerSettings:
     """TPU-native tuning knobs (no reference equivalent; see cruise_config.py)."""
@@ -375,9 +380,14 @@ class GoalOptimizer:
         self,
         constraint: Optional[BalancingConstraint] = None,
         settings: OptimizerSettings = OptimizerSettings(),
+        mesh=None,
     ):
+        """`mesh`: optional jax.sharding.Mesh with a `partitions` axis; when
+        given, the model is padded to the mesh size and the per-round scoring
+        shards the partition axis across chips (cruise_control_tpu.parallel)."""
         self._constraint = constraint or BalancingConstraint.default()
         self._settings = settings
+        self._mesh = mesh
 
     def optimizations(
         self,
@@ -388,12 +398,33 @@ class GoalOptimizer:
     ) -> OptimizerResult:
         t0 = time.monotonic()
         goals = goals_by_priority(goal_names)
+        p_orig = model.num_partitions
+        if self._mesh is not None:
+            from cruise_control_tpu.parallel.sharding import (
+                pad_partitions,
+                place_aggregates,
+                place_static,
+                shard_model,
+            )
+
+            model = shard_model(pad_partitions(model, self._mesh.size), self._mesh)
+            if options.excluded_partitions is not None and model.num_partitions > p_orig:
+                pad = np.ones(model.num_partitions - p_orig, dtype=bool)
+                options = dataclasses.replace(
+                    options,
+                    excluded_partitions=np.concatenate(
+                        [np.asarray(options.excluded_partitions, dtype=bool), pad]
+                    ),
+                )
         dims = dims_of(model)
         static = build_static_ctx(model, self._constraint, dims, options)
         init_assignment = jnp.asarray(model.assignment)
         agg = compute_aggregates(static, init_assignment, dims)
+        if self._mesh is not None:
+            static = place_static(static, self._mesh)
+            agg = place_aggregates(agg, self._mesh)
 
-        stats_before = jax.jit(compute_stats, static_argnums=1)(model, dims.num_topics)
+        stats_before = _jit_compute_stats(model, dims.num_topics)
 
         goal_results: List[GoalResult] = []
         prior_names: Tuple[str, ...] = ()
@@ -424,11 +455,14 @@ class GoalOptimizer:
             prior_names = prior_names + (goal.name,)
 
         final_model = model._replace(assignment=agg.assignment)
-        stats_after = jax.jit(compute_stats, static_argnums=1)(final_model, dims.num_topics)
+        stats_after = _jit_compute_stats(final_model, dims.num_topics)
 
-        init_np = np.asarray(init_assignment)
-        final_np = np.asarray(agg.assignment)
-        proposals = proposal_diff(init_np, final_np, np.asarray(model.part_load))
+        # drop mesh-padding rows: pad rows never change, so proposals/stats are
+        # unaffected and the returned assignment round-trips with the caller's
+        # unpadded part_load.
+        init_np = np.asarray(init_assignment)[:p_orig]
+        final_np = np.asarray(agg.assignment)[:p_orig]
+        proposals = proposal_diff(init_np, final_np, np.asarray(model.part_load)[:p_orig])
         n_moves = sum(len(pr.replicas_to_add) for pr in proposals)
         n_leader = sum(
             1

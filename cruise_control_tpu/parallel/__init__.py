@@ -1,0 +1,27 @@
+"""Device-mesh parallelism for the analyzer kernels.
+
+The reference is a single-JVM multi-threaded optimizer; its only "distributed"
+surface is client-server I/O (SURVEY.md §2, §5). Here the optimizer itself is
+the SPMD program: candidate-action grids are data-parallel over the partition
+axis, so the natural mesh is one `partitions` axis over all chips — per-round
+scoring shards over ICI and the top-k / argmax reductions become XLA
+collectives inserted by GSPMD.
+"""
+
+from cruise_control_tpu.parallel.sharding import (
+    PARTITION_AXIS,
+    make_mesh,
+    pad_partitions,
+    place_aggregates,
+    place_static,
+    shard_model,
+)
+
+__all__ = [
+    "PARTITION_AXIS",
+    "make_mesh",
+    "pad_partitions",
+    "place_aggregates",
+    "place_static",
+    "shard_model",
+]

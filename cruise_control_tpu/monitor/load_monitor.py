@@ -1,0 +1,335 @@
+"""LoadMonitor: windows -> FlatClusterModel.
+
+Analog of cc/monitor/LoadMonitor.java:68 — owns the partition and broker
+aggregators, samples through the pluggable sampler, persists through the
+sample store, and on demand assembles the flattened cluster model
+(clusterModel :422-487: topology from metadata + capacities from the resolver
++ per-partition window loads). Model generation is guarded by a fairness
+semaphore (`acquire_for_model_generation` :357) and the result summarizes into
+BrokerStats for the /load endpoint.
+
+The window->expected-utilization reduction (Load.expectedUtilizationFor) is
+where windows collapse to the part_load matrix: CPU/NW are window-averaged,
+DISK takes the latest window — computed as one numpy pass over the
+aggregation result.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import NUM_PART_METRICS, BrokerState, PartMetric
+from cruise_control_tpu.models.flat_model import ClusterMetadata, FlatClusterModel
+from cruise_control_tpu.models.model_utils import follower_cpu_util_from_leader_load
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    WindowedAggregator,
+)
+from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.metadata import (
+    BrokerCapacityConfigResolver,
+    MetadataClient,
+    StaticCapacityResolver,
+)
+from cruise_control_tpu.monitor.metricdef import (
+    AGGREGATION_OF,
+    NUM_BROKER_METRICS,
+    NUM_COMMON_METRICS,
+    COMMON_METRIC_DEFS,
+    KafkaMetricDef,
+)
+from cruise_control_tpu.monitor.sample_store import NoopSampleStore, SampleStore
+from cruise_control_tpu.monitor.sampler import MetricSampler, Samples
+from cruise_control_tpu.monitor.samples import as_batch
+
+
+@dataclasses.dataclass(frozen=True)
+class LoadMonitorConfig:
+    """Window knobs; key names mirror num.partition.metrics.windows etc."""
+
+    window_ms: int = 60_000
+    num_windows: int = 5
+    min_samples_per_window: int = 3
+    num_broker_windows: int = 20
+    sampling_interval_s: float = 10.0
+
+
+class LoadMonitorState:
+    NOT_STARTED = "NOT_STARTED"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    SAMPLING = "SAMPLING"
+    BOOTSTRAPPING = "BOOTSTRAPPING"
+    TRAINING = "TRAINING"
+    LOADING = "LOADING"
+
+
+class LoadMonitor:
+    def __init__(
+        self,
+        metadata_client: MetadataClient,
+        sampler: MetricSampler,
+        sample_store: Optional[SampleStore] = None,
+        capacity_resolver: Optional[BrokerCapacityConfigResolver] = None,
+        config: LoadMonitorConfig = LoadMonitorConfig(),
+        clock: Callable[[], float] = time.time,
+    ):
+        self._metadata = metadata_client
+        self._sampler = sampler
+        self._store = sample_store or NoopSampleStore()
+        self._capacity = capacity_resolver or StaticCapacityResolver()
+        self._config = config
+        self._clock = clock
+        self._state = LoadMonitorState.NOT_STARTED
+        self._sampling_paused = False
+        self._pause_reason: Optional[str] = None
+        self._model_semaphore = threading.Semaphore(1)
+        self._lock = threading.RLock()
+        self._last_sample_ms = 0
+        # sensor counters (cluster-model-creation-timer analog)
+        self.sensors: Dict[str, float] = {"model_creations": 0, "model_creation_time_s": 0.0}
+
+        topo = metadata_client.refresh_metadata()
+        common_fns = [AGGREGATION_OF[d] for d in COMMON_METRIC_DEFS]
+        broker_fns = [AGGREGATION_OF[d] for d in KafkaMetricDef]
+        self._partition_agg = WindowedAggregator(
+            num_entities=topo.num_partitions,
+            num_metrics=NUM_COMMON_METRICS,
+            aggregation_functions=common_fns,
+            window_ms=config.window_ms,
+            num_windows=config.num_windows,
+            min_samples_per_window=config.min_samples_per_window,
+            entity_group=np.asarray(topo.topic_id, dtype=np.int64),
+        )
+        self._broker_agg = WindowedAggregator(
+            num_entities=topo.num_brokers,
+            num_metrics=NUM_BROKER_METRICS,
+            aggregation_functions=broker_fns,
+            window_ms=config.window_ms,
+            num_windows=config.num_broker_windows,
+            min_samples_per_window=1,
+        )
+
+    # -- lifecycle / state -----------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def start_up(self) -> None:
+        """Replay the sample store (SampleLoadingTask analog), then run."""
+        with self._lock:
+            self._state = LoadMonitorState.LOADING
+        part, brok = self._store.load_samples()
+        if part or brok:
+            self._add_samples(Samples(part, brok), persist=False)
+        with self._lock:
+            self._state = LoadMonitorState.RUNNING
+
+    def pause_metric_sampling(self, reason: str = "") -> None:
+        with self._lock:
+            self._sampling_paused = True
+            self._pause_reason = reason
+            self._state = LoadMonitorState.PAUSED
+
+    def resume_metric_sampling(self) -> None:
+        with self._lock:
+            self._sampling_paused = False
+            self._pause_reason = None
+            self._state = LoadMonitorState.RUNNING
+
+    @property
+    def sampling_paused(self) -> bool:
+        with self._lock:
+            return self._sampling_paused
+
+    # -- sampling --------------------------------------------------------------
+
+    def sample_once(self) -> int:
+        """One sampling round (SamplingTask analog); returns samples ingested."""
+        with self._lock:
+            if self._sampling_paused:
+                return 0
+            self._state = LoadMonitorState.SAMPLING
+        try:
+            topo = self._metadata.refresh_metadata()
+            self._ensure_universe(topo)
+            now_ms = int(self._clock() * 1000)
+            start_ms = self._last_sample_ms
+            samples = self._sampler.get_samples(topo, start_ms, now_ms)
+            self._last_sample_ms = now_ms
+            return self._add_samples(samples, persist=True)
+        finally:
+            with self._lock:
+                if not self._sampling_paused:
+                    self._state = LoadMonitorState.RUNNING
+
+    def bootstrap(self, samples: Samples) -> int:
+        """Backfill historic samples (LoadMonitorTaskRunner.bootstrap :127)."""
+        with self._lock:
+            self._state = LoadMonitorState.BOOTSTRAPPING
+        try:
+            topo = self._metadata.refresh_metadata()
+            self._ensure_universe(topo)
+            return self._add_samples(samples, persist=False)
+        finally:
+            with self._lock:
+                self._state = LoadMonitorState.RUNNING
+
+    def _ensure_universe(self, topo) -> None:
+        if topo.num_partitions > self._partition_agg.num_entities:
+            self._partition_agg.resize(
+                topo.num_partitions, np.asarray(topo.topic_id, dtype=np.int64)
+            )
+        if topo.num_brokers > self._broker_agg.num_entities:
+            self._broker_agg.resize(topo.num_brokers)
+
+    def _add_samples(self, samples: Samples, persist: bool) -> int:
+        n = 0
+        part = as_batch(samples.partition_samples, "partition")
+        brok = as_batch(samples.broker_samples, "broker")
+        if len(part):
+            n += self._partition_agg.add_samples(part.ids, part.times, part.metrics)
+        if len(brok):
+            n += self._broker_agg.add_samples(brok.ids, brok.times, brok.metrics)
+        if persist and (len(part) or len(brok)):
+            self._store.store_samples(part, brok)
+        return n
+
+    # -- completeness ----------------------------------------------------------
+
+    def meet_completeness_requirements(self, req: ModelCompletenessRequirements) -> bool:
+        """LoadMonitor.meetCompletenessRequirements (:539)."""
+        options = AggregationOptions(
+            min_valid_entity_ratio=req.min_monitored_partitions_percentage,
+            min_valid_windows=req.min_required_num_windows,
+        )
+        return self._partition_agg.meets(options)
+
+    @property
+    def generation(self) -> int:
+        """Model generation: bumps when windows or topology change."""
+        return self._partition_agg.generation + self._metadata.generation
+
+    # -- model assembly --------------------------------------------------------
+
+    def acquire_for_model_generation(self, timeout_s: float = 60.0):
+        """Fairness semaphore around model builds (LoadMonitor:357)."""
+        acquired = self._model_semaphore.acquire(timeout=timeout_s)
+        if not acquired:
+            raise TimeoutError("could not acquire model-generation semaphore")
+
+        class _Release:
+            def __enter__(inner):
+                return inner
+
+            def __exit__(inner, *exc):
+                self._model_semaphore.release()
+                return False
+
+        return _Release()
+
+    def cluster_model(
+        self,
+        requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(),
+        allow_capacity_estimation: bool = True,
+    ) -> tuple:
+        """Build (FlatClusterModel, ClusterMetadata) from current windows.
+
+        The flattening pass of LoadMonitor.clusterModel (:422-487): topology
+        arrays come straight from metadata; part_load comes from the window
+        aggregation, leader/follower split via the CPU attribution model."""
+        t0 = self._clock()
+        topo = self._metadata.refresh_metadata()
+        self._ensure_universe(topo)
+
+        agg = self._partition_agg.aggregate(
+            options=AggregationOptions(
+                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+                min_valid_windows=requirements.min_required_num_windows,
+            )
+        )
+        c = agg.completeness
+        if c.valid_entity_ratio < requirements.min_monitored_partitions_percentage:
+            raise ValueError(
+                f"not enough valid partitions: {c.valid_entity_ratio:.3f} < "
+                f"{requirements.min_monitored_partitions_percentage:.3f}"
+            )
+        if len(c.valid_windows) < requirements.min_required_num_windows:
+            raise ValueError(
+                f"not enough valid windows: {len(c.valid_windows)} < "
+                f"{requirements.min_required_num_windows}"
+            )
+
+        values = agg.values  # f32[P, W, M_common]
+        # windows -> expected utilization (Load.expectedUtilizationFor):
+        # AVG metrics average over windows; LATEST (disk) takes the newest.
+        win_avg = values.mean(axis=1)  # [P, M]
+        disk = values[:, -1, KafkaMetricDef.DISK_USAGE]
+        cpu = win_avg[:, KafkaMetricDef.CPU_USAGE]
+        l_in = win_avg[:, KafkaMetricDef.LEADER_BYTES_IN]
+        l_out = win_avg[:, KafkaMetricDef.LEADER_BYTES_OUT]
+
+        part_load = np.zeros((topo.num_partitions, NUM_PART_METRICS), dtype=np.float32)
+        part_load[:, PartMetric.CPU_LEADER] = cpu
+        part_load[:, PartMetric.CPU_FOLLOWER] = follower_cpu_util_from_leader_load(
+            l_in, l_out, cpu
+        )
+        part_load[:, PartMetric.NW_IN_LEADER] = l_in
+        part_load[:, PartMetric.NW_IN_FOLLOWER] = l_in  # replication pulls leader input
+        part_load[:, PartMetric.NW_OUT_LEADER] = l_out
+        part_load[:, PartMetric.DISK] = disk
+
+        capacities = np.stack(
+            [self._capacity.capacity_for_broker(int(bid)) for bid in topo.broker_ids]
+        )
+
+        model = FlatClusterModel(
+            assignment=np.asarray(topo.assignment, dtype=np.int32),
+            part_load=part_load,
+            topic_id=np.asarray(topo.topic_id, dtype=np.int32),
+            broker_capacity=capacities.astype(np.float32),
+            broker_rack=np.asarray(topo.broker_rack, dtype=np.int32),
+            broker_host=np.asarray(topo.broker_host, dtype=np.int32),
+            broker_state=np.asarray(topo.broker_state, dtype=np.int32),
+        )
+        meta = ClusterMetadata(
+            topic_names=tuple(topo.topic_names),
+            partition_index=np.asarray(topo.partition_index, dtype=np.int32),
+            broker_ids=np.asarray(topo.broker_ids, dtype=np.int32),
+            topic_of_partition=np.asarray(topo.topic_id, dtype=np.int32),
+        )
+        self.sensors["model_creations"] += 1
+        self.sensors["model_creation_time_s"] += self._clock() - t0
+        return model, meta
+
+    def broker_stats(self) -> Dict:
+        """Per-broker load summary for /load (LoadMonitor.cachedBrokerLoadStats)."""
+        from cruise_control_tpu.models.flat_model import broker_loads, leader_counts, replica_counts
+
+        model, meta = self.cluster_model(ModelCompletenessRequirements(0, 0.0, False))
+        loads = np.asarray(broker_loads(model))
+        reps = np.asarray(replica_counts(model))
+        lead = np.asarray(leader_counts(model))
+        return {
+            "brokers": [
+                {
+                    "Broker": int(meta.broker_ids[i]),
+                    "BrokerState": BrokerState(int(model.broker_state[i])).name,
+                    "CpuPct": float(loads[i, 0]),
+                    "NwInRate": float(loads[i, 1]),
+                    "NwOutRate": float(loads[i, 2]),
+                    "DiskMB": float(loads[i, 3]),
+                    "Replicas": int(reps[i]),
+                    "Leaders": int(lead[i]),
+                }
+                for i in range(model.num_brokers)
+            ]
+        }

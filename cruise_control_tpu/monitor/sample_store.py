@@ -1,0 +1,94 @@
+"""Sample persistence SPI — the checkpoint/resume mechanism.
+
+Analog of SampleStore (cc/monitor/sampling/SampleStore.java:17) and
+KafkaSampleStore (cc/monitor/sampling/KafkaSampleStore.java:79): metric
+samples are the ONLY durable state; windows are rebuilt by replaying them on
+startup (SampleLoadingTask). The default here is an append-only local file
+pair; a Kafka/object-store impl plugs in through the same SPI.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from typing import Iterable, List, Tuple
+
+from cruise_control_tpu.monitor.samples import (
+    BrokerMetricSample,
+    PartitionMetricSample,
+    deserialize_sample,
+    serialize_sample,
+)
+
+
+class SampleStore:
+    def store_samples(
+        self,
+        partition_samples: Iterable[PartitionMetricSample],
+        broker_samples: Iterable[BrokerMetricSample],
+    ) -> None:
+        raise NotImplementedError
+
+    def load_samples(self) -> Tuple[List[PartitionMetricSample], List[BrokerMetricSample]]:
+        """Replay everything retained (KafkaSampleStore.loadSamples :332)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class NoopSampleStore(SampleStore):
+    def store_samples(self, partition_samples, broker_samples) -> None:
+        pass
+
+    def load_samples(self):
+        return [], []
+
+
+class FileSampleStore(SampleStore):
+    """Length-prefixed binary records in two append-only files."""
+
+    def __init__(self, directory: str):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._paths = {
+            "partition": os.path.join(directory, "partition-samples.bin"),
+            "broker": os.path.join(directory, "broker-samples.bin"),
+        }
+
+    def _append(self, path: str, samples) -> None:
+        with open(path, "ab") as f:
+            for s in samples:
+                payload = serialize_sample(s)
+                f.write(len(payload).to_bytes(4, "big") + payload)
+
+    def store_samples(self, partition_samples, broker_samples) -> None:
+        with self._lock:
+            self._append(self._paths["partition"], partition_samples)
+            self._append(self._paths["broker"], broker_samples)
+
+    def _read(self, path: str) -> List:
+        out = []
+        try:
+            with open(path, "rb") as f:
+                while True:
+                    head = f.read(4)
+                    if len(head) < 4:
+                        break
+                    size = int.from_bytes(head, "big")
+                    payload = f.read(size)
+                    if len(payload) < size:
+                        break  # torn tail from a crash mid-append: stop here
+                    try:
+                        out.append(deserialize_sample(payload))
+                    except (ValueError, struct.error):
+                        break  # corrupt tail record; keep what was readable
+        except FileNotFoundError:
+            pass
+        return out
+
+    def load_samples(self):
+        with self._lock:
+            return self._read(self._paths["partition"]), self._read(self._paths["broker"])

@@ -1,0 +1,60 @@
+"""Sampling scheduler: the LoadMonitorTaskRunner analog.
+
+Mirrors cc/monitor/task/LoadMonitorTaskRunner.java:30 — a background scheduler
+driving periodic sampling rounds against the LoadMonitor, with the reference's
+state machine (NOT_STARTED/RUNNING/SAMPLING/PAUSED/BOOTSTRAPPING/...) living
+on the monitor itself and pause/resume (:273-295) forwarded through here.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor
+from cruise_control_tpu.monitor.sampler import Samples
+
+
+class LoadMonitorTaskRunner:
+    def __init__(self, monitor: LoadMonitor, sampling_interval_s: Optional[float] = None):
+        self._monitor = monitor
+        self._interval = (
+            sampling_interval_s
+            if sampling_interval_s is not None
+            else monitor._config.sampling_interval_s
+        )
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> None:
+        """LoadMonitorTaskRunner.start (:225): replay store, begin sampling."""
+        if self._thread is not None:
+            raise RuntimeError("task runner already started")
+        self._monitor.start_up()
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self._interval):
+                try:
+                    self._monitor.sample_once()
+                except Exception:
+                    pass  # sampling errors must not kill the loop
+
+        self._thread = threading.Thread(target=run, name="load-monitor-sampler", daemon=True)
+        self._thread.start()
+
+    def bootstrap(self, samples: Samples) -> int:
+        """Backfill mode (BootstrapTask analog)."""
+        return self._monitor.bootstrap(samples)
+
+    def pause_sampling(self, reason: str = "") -> None:
+        self._monitor.pause_metric_sampling(reason)
+
+    def resume_sampling(self) -> None:
+        self._monitor.resume_metric_sampling()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

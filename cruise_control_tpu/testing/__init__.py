@@ -1,0 +1,12 @@
+"""Test/simulation harness.
+
+The analog of the reference's embedded-cluster integration tier
+(AbstractKafkaIntegrationTestHarness, SURVEY.md §4 tier 5): an in-process
+simulated cluster that produces real raw metrics through the reporter
+transport and accepts executor operations, so the full
+reporter -> monitor -> analyzer -> executor loop runs without Kafka.
+"""
+
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+__all__ = ["SimulatedCluster"]

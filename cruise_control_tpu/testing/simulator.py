@@ -1,0 +1,196 @@
+"""In-process cluster simulator.
+
+Holds a ground-truth FlatClusterModel and plays every external role the
+reference gets from a live Kafka cluster:
+
+- metadata backend for MetadataClient (`fetch_topology`)
+- per-broker metric sources for MetricsReporter (`metric_source`), emitting
+  the same raw types the in-broker agent produces (byte rates in bytes/s,
+  partition sizes in bytes, broker CPU in cumulative util) so the processor's
+  unit conversions and CPU attribution are exercised end to end
+- cluster mutation surface for the executor (`apply_movement`,
+  `apply_leadership`, `kill_broker`, `restore_broker`, `add_broker`) with
+  configurable completion latency, standing in for the ZK-reassignment path
+  (scala/executor/ExecutorUtils.scala:32)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from cruise_control_tpu.common.resources import BrokerState, PartMetric
+from cruise_control_tpu.models.flat_model import ClusterMetadata, FlatClusterModel
+from cruise_control_tpu.models.generators import metadata_for
+from cruise_control_tpu.monitor.metadata import ClusterTopology
+from cruise_control_tpu.monitor.processor import BYTES_IN_KB, BYTES_IN_MB
+from cruise_control_tpu.reporter.metrics import (
+    BrokerMetric,
+    CruiseControlMetric,
+    PartitionMetric,
+    RawMetricType,
+    TopicMetric,
+)
+
+
+class SimulatedCluster:
+    def __init__(self, model: FlatClusterModel, metadata: Optional[ClusterMetadata] = None):
+        self._lock = threading.RLock()
+        self._assignment = np.array(model.assignment, dtype=np.int32)
+        self._part_load = np.array(model.part_load, dtype=np.float32)
+        self._topic_id = np.array(model.topic_id, dtype=np.int32)
+        self._capacity = np.array(model.broker_capacity, dtype=np.float32)
+        self._rack = np.array(model.broker_rack, dtype=np.int32)
+        self._host = np.array(model.broker_host, dtype=np.int32)
+        self._state = np.array(model.broker_state, dtype=np.int32)
+        self._meta = metadata or metadata_for(model)
+
+    # -- snapshots -------------------------------------------------------------
+
+    def model(self) -> FlatClusterModel:
+        with self._lock:
+            return FlatClusterModel(
+                assignment=self._assignment.copy(),
+                part_load=self._part_load.copy(),
+                topic_id=self._topic_id.copy(),
+                broker_capacity=self._capacity.copy(),
+                broker_rack=self._rack.copy(),
+                broker_host=self._host.copy(),
+                broker_state=self._state.copy(),
+            )
+
+    def fetch_topology(self) -> ClusterTopology:
+        """Backend for MetadataClient."""
+        with self._lock:
+            return ClusterTopology(
+                topic_names=self._meta.topic_names,
+                topic_id=self._topic_id.copy(),
+                partition_index=np.asarray(self._meta.partition_index, dtype=np.int32),
+                assignment=self._assignment.copy(),
+                broker_ids=np.asarray(self._meta.broker_ids, dtype=np.int32),
+                broker_rack=self._rack.copy(),
+                broker_host=self._host.copy(),
+                broker_state=self._state.copy(),
+            )
+
+    # -- reporter metric sources -----------------------------------------------
+
+    def metric_source(self, broker_index: int) -> Callable[[int], List[CruiseControlMetric]]:
+        """Raw-metric source for one broker's MetricsReporter."""
+
+        def source(now_ms: int) -> List[CruiseControlMetric]:
+            with self._lock:
+                if self._state[broker_index] == BrokerState.DEAD:
+                    return []
+                bid = int(self._meta.broker_ids[broker_index])
+                a = self._assignment
+                pl = self._part_load
+                leads = a[:, 0] == broker_index
+                follows = (a[:, 1:] == broker_index).any(axis=1)
+                out: List[CruiseControlMetric] = []
+
+                cpu = float(
+                    pl[leads, PartMetric.CPU_LEADER].sum()
+                    + pl[follows, PartMetric.CPU_FOLLOWER].sum()
+                )
+                bytes_in = float(pl[leads, PartMetric.NW_IN_LEADER].sum()) * BYTES_IN_KB
+                bytes_out = float(pl[leads, PartMetric.NW_OUT_LEADER].sum()) * BYTES_IN_KB
+                rep_in = float(pl[follows, PartMetric.NW_IN_FOLLOWER].sum()) * BYTES_IN_KB
+                # a leader ships NW_IN_FOLLOWER to EACH of its followers
+                n_followers = (a[:, 1:] >= 0).sum(axis=1).astype(np.float32)
+                rep_out = float(
+                    (pl[leads, PartMetric.NW_IN_FOLLOWER] * n_followers[leads]).sum()
+                ) * BYTES_IN_KB
+                out.append(BrokerMetric(RawMetricType.BROKER_CPU_UTIL, now_ms, bid, cpu))
+                out.append(BrokerMetric(RawMetricType.ALL_TOPIC_BYTES_IN, now_ms, bid, bytes_in))
+                out.append(BrokerMetric(RawMetricType.ALL_TOPIC_BYTES_OUT, now_ms, bid, bytes_out))
+                out.append(
+                    BrokerMetric(RawMetricType.ALL_TOPIC_REPLICATION_BYTES_IN, now_ms, bid, rep_in)
+                )
+                out.append(
+                    BrokerMetric(RawMetricType.ALL_TOPIC_REPLICATION_BYTES_OUT, now_ms, bid, rep_out)
+                )
+
+                # per-topic IO led by this broker
+                led = np.nonzero(leads)[0]
+                for t in np.unique(self._topic_id[led]):
+                    sel = led[self._topic_id[led] == t]
+                    name = self._meta.topic_names[int(t)]
+                    t_in = float(pl[sel, PartMetric.NW_IN_LEADER].sum()) * BYTES_IN_KB
+                    t_out = float(pl[sel, PartMetric.NW_OUT_LEADER].sum()) * BYTES_IN_KB
+                    t_rep_in = float(pl[sel, PartMetric.NW_IN_FOLLOWER].sum()) * BYTES_IN_KB
+                    t_rep_out = float(
+                        (pl[sel, PartMetric.NW_IN_FOLLOWER] * n_followers[sel]).sum()
+                    ) * BYTES_IN_KB
+                    out.append(TopicMetric(RawMetricType.TOPIC_BYTES_IN, now_ms, bid, name, t_in))
+                    out.append(TopicMetric(RawMetricType.TOPIC_BYTES_OUT, now_ms, bid, name, t_out))
+                    out.append(
+                        TopicMetric(RawMetricType.TOPIC_REPLICATION_BYTES_IN, now_ms, bid, name, t_rep_in)
+                    )
+                    out.append(
+                        TopicMetric(RawMetricType.TOPIC_REPLICATION_BYTES_OUT, now_ms, bid, name, t_rep_out)
+                    )
+                    # partition sizes for this topic's leader partitions here
+                    for pid in sel:
+                        out.append(
+                            PartitionMetric(
+                                RawMetricType.PARTITION_SIZE,
+                                now_ms,
+                                bid,
+                                name,
+                                int(self._meta.partition_index[pid]),
+                                float(pl[pid, PartMetric.DISK]) * BYTES_IN_MB,
+                            )
+                        )
+                return out
+
+        return source
+
+    def all_metrics(self, now_ms: int) -> List[CruiseControlMetric]:
+        """Every alive broker's metrics for one interval."""
+        out: List[CruiseControlMetric] = []
+        for i in range(self._state.shape[0]):
+            out.extend(self.metric_source(i)(now_ms))
+        return out
+
+    # -- executor surface ------------------------------------------------------
+
+    def apply_movement(self, partition: int, source_broker: int, dest_broker: int) -> bool:
+        """Replace source_broker with dest_broker in the partition's replica
+        set (the reassignment the ZK write would trigger)."""
+        with self._lock:
+            row = self._assignment[partition]
+            slots = np.nonzero(row == source_broker)[0]
+            if slots.size == 0 or (row == dest_broker).any():
+                return False
+            self._assignment[partition, slots[0]] = dest_broker
+            return True
+
+    def apply_leadership(self, partition: int, new_leader_broker: int) -> bool:
+        """Preferred-leader election to an in-set replica."""
+        with self._lock:
+            row = self._assignment[partition]
+            slots = np.nonzero(row == new_leader_broker)[0]
+            if slots.size == 0:
+                return False
+            s = slots[0]
+            row[0], row[s] = row[s], row[0]
+            return True
+
+    def kill_broker(self, broker_index: int) -> None:
+        with self._lock:
+            self._state[broker_index] = BrokerState.DEAD
+
+    def restore_broker(self, broker_index: int) -> None:
+        with self._lock:
+            self._state[broker_index] = BrokerState.ALIVE
+
+    def has_partition(self, partition: int, broker_index: int) -> bool:
+        with self._lock:
+            return bool((self._assignment[partition] == broker_index).any())
+
+    def leader_of(self, partition: int) -> int:
+        with self._lock:
+            return int(self._assignment[partition, 0])

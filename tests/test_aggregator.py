@@ -1,0 +1,158 @@
+"""Windowed aggregator semantics.
+
+Array-native port of the core aggregator test tier
+(cruise-control-core/src/test MetricSampleAggregatorTest / RawMetricValuesTest
+with IntegerEntity, SURVEY.md §4 tier 4): window math, AVG/MAX/LATEST
+strategies, the extrapolation ladder, completeness, and generation bumps."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.monitor.aggregator import (
+    AggregationOptions,
+    Extrapolation,
+    Granularity,
+    WindowedAggregator,
+)
+from cruise_control_tpu.monitor.metricdef import AggregationFunction
+
+WINDOW_MS = 1000
+
+
+def make_agg(num_entities=2, num_windows=4, min_samples=2):
+    return WindowedAggregator(
+        num_entities=num_entities,
+        num_metrics=3,
+        aggregation_functions=[
+            AggregationFunction.AVG,
+            AggregationFunction.MAX,
+            AggregationFunction.LATEST,
+        ],
+        window_ms=WINDOW_MS,
+        num_windows=num_windows,
+        min_samples_per_window=min_samples,
+    )
+
+
+def add(agg, entity, t_ms, vals):
+    return agg.add_samples(np.array([entity]), np.array([t_ms]), np.array([vals], np.float32))
+
+
+def test_strategies_within_one_window():
+    agg = make_agg()
+    add(agg, 0, 100, [1.0, 5.0, 10.0])
+    add(agg, 0, 200, [3.0, 2.0, 20.0])
+    res = agg.aggregate(windows=[0])
+    vals = res.values[0, 0]
+    assert vals[0] == pytest.approx(2.0)  # AVG of 1, 3
+    assert vals[1] == pytest.approx(5.0)  # MAX of 5, 2
+    assert vals[2] == pytest.approx(20.0)  # LATEST by time
+    assert res.extrapolations[0, 0] == Extrapolation.NONE
+
+
+def test_latest_keeps_greatest_timestamp_regardless_of_batch_order():
+    agg = make_agg()
+    agg.add_samples(
+        np.array([0, 0]),
+        np.array([900, 300]),
+        np.array([[1, 1, 99.0], [1, 1, 11.0]], np.float32),
+    )
+    res = agg.aggregate(windows=[0])
+    assert res.values[0, 0, 2] == pytest.approx(99.0)
+
+
+def test_extrapolation_ladder():
+    # min_samples=4 => half_min=2
+    agg = make_agg(num_entities=4, num_windows=3, min_samples=4)
+    # entity 0: sufficient in window 1 (4 samples)
+    for t in (1100, 1200, 1300, 1400):
+        add(agg, 0, t, [1, 1, 1])
+    # entity 1: 2 samples in window 1 => AVG_AVAILABLE
+    add(agg, 1, 1100, [2, 2, 2])
+    add(agg, 1, 1200, [4, 4, 4])
+    # entity 2: full neighbors (windows 0 and 2), 0 in window 1 => AVG_ADJACENT
+    for t in (100, 200, 300, 400):
+        add(agg, 2, t, [8, 8, 8])
+    for t in (2100, 2200, 2300, 2400):
+        add(agg, 2, t, [16, 16, 16])
+    # entity 3: 1 sample in window 1 (below half), no neighbors => FORCED_INSUFFICIENT
+    add(agg, 3, 1100, [7, 7, 7])
+
+    res = agg.aggregate(windows=[0, 1, 2])
+    ex = res.extrapolations
+    assert ex[0, 1] == Extrapolation.NONE
+    assert ex[1, 1] == Extrapolation.AVG_AVAILABLE
+    assert res.values[1, 1, 0] == pytest.approx(3.0)
+    assert ex[2, 1] == Extrapolation.AVG_ADJACENT
+    # AVG strategy: total sum / total count = (4*8 + 0 + 4*16) / 8 = 12
+    assert res.values[2, 1, 0] == pytest.approx(12.0)
+    # MAX strategy with empty middle window: (8 + 16) / 2
+    assert res.values[2, 1, 1] == pytest.approx(12.0)
+    assert ex[3, 1] == Extrapolation.FORCED_INSUFFICIENT
+    assert res.values[3, 1, 0] == pytest.approx(7.0)
+    # entity 3 window 0: nothing at all
+    assert ex[3, 0] == Extrapolation.NO_VALID_EXTRAPOLATION
+    assert res.values[3, 0, 0] == 0.0
+
+
+def test_window_roll_drops_oldest():
+    agg = make_agg(num_windows=3)
+    add(agg, 0, 500, [1, 1, 1])
+    assert agg.current_window() == 0
+    add(agg, 0, 5500, [2, 2, 2])  # jump to window 5; windows 2,3,4 retained + current 5
+    assert agg.current_window() == 5
+    with pytest.raises(ValueError):
+        agg.aggregate(windows=[0])
+
+
+def test_generation_bumps_on_completed_window_changes():
+    agg = make_agg()
+    g0 = agg.generation
+    add(agg, 0, 100, [1, 1, 1])  # lands in current window
+    g1 = agg.generation
+    add(agg, 0, 5000, [1, 1, 1])  # rolls windows
+    g2 = agg.generation
+    assert g2 > g1 >= g0
+    add(agg, 0, 4100, [1, 1, 1])  # lands in a completed window -> bump
+    assert agg.generation > g2
+
+
+def test_completeness_entity_and_group():
+    group = np.array([0, 0, 1], dtype=np.int64)
+    agg = WindowedAggregator(
+        num_entities=3,
+        num_metrics=1,
+        aggregation_functions=[AggregationFunction.AVG],
+        window_ms=WINDOW_MS,
+        num_windows=2,
+        min_samples_per_window=1,
+        entity_group=group,
+    )
+    # entities 0 and 2 fully sampled in completed windows 0 and 1 (the sample
+    # at 2100 completes window 1); entity 1 empty
+    for e in (0, 2):
+        for t in (100, 1100, 2100):
+            add(agg, e, t, [1.0])
+    res = agg.aggregate(windows=[0, 1])
+    assert res.valid_entities.tolist() == [True, False, True]
+    assert res.completeness.valid_entity_ratio == pytest.approx(2 / 3)
+    # group 0 has an invalid member -> half the groups valid
+    assert res.completeness.valid_entity_group_ratio == pytest.approx(0.5)
+    # ENTITY_GROUP granularity invalidates entity 0 too
+    res_g = agg.aggregate(
+        windows=[0, 1], options=AggregationOptions(granularity=Granularity.ENTITY_GROUP)
+    )
+    assert res_g.valid_entities.tolist() == [False, False, True]
+
+    assert agg.meets(AggregationOptions(min_valid_entity_ratio=0.5, min_valid_windows=2))
+    assert not agg.meets(AggregationOptions(min_valid_entity_ratio=0.9))
+
+
+def test_resize_keeps_history():
+    agg = make_agg(num_entities=1)
+    add(agg, 0, 100, [5, 5, 5])
+    agg.resize(3)
+    add(agg, 2, 200, [7, 7, 7])
+    res = agg.aggregate(windows=[0])
+    assert res.values[0, 0, 0] == pytest.approx(5.0)
+    assert res.values[2, 0, 0] == pytest.approx(7.0)

@@ -166,6 +166,36 @@ def make_leadership_batch(part_load: jax.Array, assignment: jax.Array) -> Action
     )
 
 
+def build_selected(part_load: jax.Array, assignment: jax.Array, p, kind, slot, dst) -> ActionBatch:
+    """Materialize concrete actions from (partition, kind, slot, dst) picks.
+
+    Shared by the optimizer's shortlist apply and the swap kernel; `p`,
+    `kind`, `slot`, `dst` may be scalars or index arrays of a common shape.
+    """
+    a = assignment
+    is_move = kind == KIND_MOVE
+    src = jnp.where(is_move, a[p, slot], a[p, 0])
+    pl = part_load[p]
+    lead = _leader_vec(part_load, p)
+    foll = _follower_vec(part_load, p)
+    move_load = jnp.where((slot == 0)[..., None], lead, foll)
+    dload = jnp.where(is_move[..., None], move_load, lead - foll)
+    leader_transfer = (~is_move) | (slot == 0)
+    return ActionBatch(
+        kind=kind,
+        p=p,
+        slot=slot,
+        src=src,
+        dst=dst,
+        valid=(src >= 0) & (dst >= 0) & (src != dst),
+        dload=dload,
+        drep=is_move.astype(jnp.int32),
+        dleader=leader_transfer.astype(jnp.int32),
+        dpnw=jnp.where(is_move, pl[..., PartMetric.NW_OUT_LEADER], 0.0),
+        dleader_nw_in=jnp.where(leader_transfer, pl[..., PartMetric.NW_IN_LEADER], 0.0),
+    )
+
+
 def gather_actions(batch: ActionBatch, *idx) -> ActionBatch:
     """Pick concrete actions out of a broadcast grid by index arrays.
 

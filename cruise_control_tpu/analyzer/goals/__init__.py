@@ -46,9 +46,28 @@ DEFAULT_GOAL_ORDER: List[Goal] = [
     LeaderBytesInDistributionGoal(),
 ]
 
-GOAL_REGISTRY: Dict[str, Goal] = {g.name: g for g in DEFAULT_GOAL_ORDER}
+from cruise_control_tpu.analyzer.goals.kafka_assigner import (  # noqa: E402
+    KafkaAssignerDiskUsageDistributionGoal,
+    KafkaAssignerEvenRackAwareGoal,
+)
+
+#: kafka-assigner mode goals: resolvable by name, excluded from the default
+#: stack; a KafkaAssigner-prefixed request switches modes
+#: (cc/KafkaCruiseControlUtils.java:193)
+KAFKA_ASSIGNER_GOALS: List[Goal] = [
+    KafkaAssignerEvenRackAwareGoal(),
+    KafkaAssignerDiskUsageDistributionGoal(),
+]
+
+GOAL_REGISTRY: Dict[str, Goal] = {
+    g.name: g for g in DEFAULT_GOAL_ORDER + KAFKA_ASSIGNER_GOALS
+}
 
 HARD_GOAL_NAMES = [g.name for g in DEFAULT_GOAL_ORDER if g.is_hard]
+
+
+def is_kafka_assigner_mode(names: Sequence[str] | None) -> bool:
+    return bool(names) and any(n.rsplit(".", 1)[-1].startswith("KafkaAssigner") for n in names)
 
 
 def get_goal(name: str) -> Goal:
@@ -60,10 +79,20 @@ def get_goal(name: str) -> Goal:
 
 
 def goals_by_priority(names: Sequence[str] | None = None) -> List[Goal]:
-    """Requested goals in default-priority order; None = the full stack."""
+    """Requested goals in default-priority order; None = the full stack.
+
+    KafkaAssigner-prefixed requests switch to kafka-assigner mode: those
+    goals run in the requested order, rack-awareness first."""
     if names is None:
         return list(DEFAULT_GOAL_ORDER)
     wanted = {get_goal(n).name for n in names}
+    if is_kafka_assigner_mode(names):
+        non_assigner = [n for n in wanted if not n.startswith("KafkaAssigner")]
+        if non_assigner:
+            raise ValueError(
+                f"cannot mix kafka-assigner and regular goals: {sorted(non_assigner)}"
+            )
+        return [g for g in KAFKA_ASSIGNER_GOALS if g.name in wanted]
     return [g for g in DEFAULT_GOAL_ORDER if g.name in wanted]
 
 

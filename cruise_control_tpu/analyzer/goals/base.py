@@ -43,6 +43,9 @@ class Goal:
     uses_moves: bool = True
     #: include the leadership candidate family when optimizing this goal
     uses_leadership: bool = False
+    #: run the replica-swap search when plain moves stall (requires a
+    #: `resource` attribute; ResourceDistributionGoal's rebalanceBySwapping*)
+    uses_swaps: bool = False
 
     def prepare(self, static: StaticCtx, agg: Aggregates, dims) -> Any:
         """Per-goal threshold state derived from current aggregates."""

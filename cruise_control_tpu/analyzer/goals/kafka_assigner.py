@@ -1,0 +1,85 @@
+"""Kafka-assigner mode goals.
+
+Drop-in replacements for the legacy kafka-assigner tool, selected when a
+request's goal list carries KafkaAssigner-prefixed names
+(cc/KafkaCruiseControlUtils.java:193 mode detection):
+
+- KafkaAssignerEvenRackAwareGoal (cc/analyzer/kafkaassigner/
+  KafkaAssignerEvenRackAwareGoal.java:41): rack awareness plus strictly even
+  replica counts per broker — here the rack-aware kernel with the replica
+  window pinned to [floor(avg), ceil(avg)].
+- KafkaAssignerDiskUsageDistributionGoal (.../
+  KafkaAssignerDiskUsageDistributionGoal.java:45): disk-usage balance with
+  swap search, a tighter-threshold DiskUsageDistributionGoal.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
+from cruise_control_tpu.analyzer.goals.base import Goal, distribution_score, imbalance
+from cruise_control_tpu.analyzer.goals.hard import RackAwareGoal
+from cruise_control_tpu.analyzer.goals.soft import ResourceDistributionGoal, WindowState
+from cruise_control_tpu.common.resources import Resource
+
+
+class KafkaAssignerEvenRackAwareGoal(Goal):
+    """Rack-aware + strictly even replica distribution, as one hard goal."""
+
+    name = "KafkaAssignerEvenRackAwareGoal"
+    is_hard = True
+    uses_moves = True
+
+    def __init__(self):
+        self._rack = RackAwareGoal()
+
+    def prepare(self, static, agg, dims):
+        n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
+        avg = jnp.sum(agg.replica_count).astype(jnp.float32) / n_alive
+        # strict evenness: every broker within one replica of the average
+        return WindowState(
+            lower=jnp.floor(avg), upper=jnp.ceil(avg), active=jnp.asarray(True)
+        )
+
+    def broker_violation(self, static, gs, agg):
+        rack_bad = self._rack.broker_violation(static, None, agg)
+        c = agg.replica_count.astype(jnp.float32)
+        uneven = ((c > gs.upper) | (c < gs.lower)) & static.alive
+        return rack_bad | uneven
+
+    def cost(self, static, gs, agg):
+        c = agg.replica_count.astype(jnp.float32)
+        even_cost = jnp.sum(jnp.where(static.alive, imbalance(c, gs.lower, gs.upper), 0.0))
+        return self._rack.cost(static, None, agg) + even_cost
+
+    def acceptance(self, static, gs, agg, act: ActionBatch):
+        rack_ok = self._rack.acceptance(static, None, agg, act)
+        is_move = act.kind == KIND_MOVE
+        dst_after = (agg.replica_count[act.dst] + 1).astype(jnp.float32)
+        # strict: later goals may never push a broker past the even window
+        even_ok = ~is_move | (dst_after <= gs.upper)
+        return rack_ok & even_ok
+
+    def action_score(self, static, gs, agg, act: ActionBatch):
+        rack_score = self._rack.action_score(static, None, agg, act)
+        is_move = act.kind == KIND_MOVE
+        c_src = agg.replica_count[act.src].astype(jnp.float32)
+        c_dst = agg.replica_count[act.dst].astype(jnp.float32)
+        even_score = distribution_score(
+            c_src, c_dst, c_src - 1.0, c_dst + 1.0, gs.lower, gs.upper,
+            tiebreak=(c_src - c_dst) * 1e-2,
+        )
+        return rack_score + jnp.where(is_move, even_score, 0.0)
+
+    def dst_preference(self, static, gs, agg):
+        return -agg.replica_count.astype(jnp.float32)
+
+
+class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
+    """Disk balance in kafka-assigner mode; same kernel as
+    DiskUsageDistributionGoal under its kafka-assigner name."""
+
+    def __init__(self):
+        super().__init__(Resource.DISK)
+        self.name = "KafkaAssignerDiskUsageDistributionGoal"

@@ -10,9 +10,9 @@ Kernels with the semantics of:
 
 Each computes its balance window from current aggregates (the analog of
 initGoalState), flags out-of-window brokers, and scores candidate actions by
-how much out-of-window distance they remove. Swap actions from the reference's
-rebalanceBySwapping* search are expressed by successive move pairs across
-rounds rather than a third action kind.
+how much out-of-window distance they remove. The reference's
+rebalanceBySwapping* search runs as the dedicated swap kernel
+(cruise_control_tpu.analyzer.swaps) whenever plain moves stall.
 """
 
 from __future__ import annotations
@@ -44,6 +44,7 @@ class ResourceDistributionGoal(Goal):
     """Per-broker utilization of one resource within [avg*lo, avg*hi]."""
 
     is_hard = False
+    uses_swaps = True  # rebalanceBySwapping* when moves stall
 
     def __init__(self, resource: Resource):
         self.resource = int(resource)

@@ -40,6 +40,7 @@ from cruise_control_tpu.analyzer.actions import (
     KIND_LEADERSHIP,
     KIND_MOVE,
     ActionBatch,
+    build_selected,
     make_leadership_batch,
     make_move_batch,
 )
@@ -80,6 +81,10 @@ class OptimizerSettings:
     batch_k: int = 64  # shortlisted actions per round; 1 = faithful greedy
     max_rounds_per_goal: int = 64
     num_dst_candidates: int = 16  # rack-representative destination brokers
+    #: swap search (ResourceDistributionGoal rebalanceBySwapping* analog):
+    #: hot/cold broker pairs per round x candidate replicas per broker
+    num_swap_pairs: int = 8
+    swap_candidates: int = 8
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -87,6 +92,8 @@ class OptimizerSettings:
             batch_k=config.get_int("optimizer.batch.actions.per.round"),
             max_rounds_per_goal=config.get_int("optimizer.max.rounds.per.goal"),
             num_dst_candidates=config.get_int("optimizer.candidate.replicas.per.broker"),
+            num_swap_pairs=config.get_int("optimizer.swap.broker.pairs"),
+            swap_candidates=config.get_int("optimizer.swap.candidate.replicas"),
         )
 
 
@@ -144,53 +151,8 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
     return best_broker[rack_idx]
 
 
-def _selected_batch(static: StaticCtx, agg: Aggregates, p, kind, slot):
-    """Materialize a concrete action batch from (partition, kind, slot) picks."""
-    a = agg.assignment
-    is_move = kind == KIND_MOVE
-    src = jnp.where(is_move, a[p, slot], a[p, 0])
-    # for moves the caller overrides dst; placeholder here
-    pl = static.part_load[p]
-    lead = jnp.stack(
-        [
-            pl[..., PartMetric.CPU_LEADER],
-            pl[..., PartMetric.NW_IN_LEADER],
-            pl[..., PartMetric.NW_OUT_LEADER],
-            pl[..., PartMetric.DISK],
-        ],
-        axis=-1,
-    )
-    foll = jnp.stack(
-        [
-            pl[..., PartMetric.CPU_FOLLOWER],
-            pl[..., PartMetric.NW_IN_FOLLOWER],
-            jnp.zeros_like(pl[..., 0]),
-            pl[..., PartMetric.DISK],
-        ],
-        axis=-1,
-    )
-    move_load = jnp.where((slot == 0)[..., None], lead, foll)
-    dload = jnp.where(is_move[..., None], move_load, lead - foll)
-    return src, dload, pl
-
-
-def _build_selected(static: StaticCtx, agg: Aggregates, p, kind, slot, dst) -> ActionBatch:
-    src, dload, pl = _selected_batch(static, agg, p, kind, slot)
-    is_move = kind == KIND_MOVE
-    leader_transfer = (~is_move) | (slot == 0)
-    return ActionBatch(
-        kind=kind,
-        p=p,
-        slot=slot,
-        src=src,
-        dst=dst,
-        valid=(src >= 0) & (dst >= 0) & (src != dst),
-        dload=dload,
-        drep=is_move.astype(jnp.int32),
-        dleader=leader_transfer.astype(jnp.int32),
-        dpnw=jnp.where(is_move, pl[..., PartMetric.NW_OUT_LEADER], 0.0),
-        dleader_nw_in=jnp.where(leader_transfer, pl[..., PartMetric.NW_IN_LEADER], 0.0),
-    )
+# concrete-action materialization lives in actions.build_selected (shared
+# with the swap kernel)
 
 
 def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: OptimizerSettings):
@@ -240,9 +202,9 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
 
         # ---- global top-k shortlist over partitions
         top_scores, top_p = jax.lax.top_k(best_score, k_sel)
-        sel = _build_selected(
-            static,
-            agg,
+        sel = build_selected(
+            static.part_load,
+            agg.assignment,
             top_p.astype(jnp.int32),
             best_kind[top_p],
             best_slot[top_p],
@@ -270,6 +232,14 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         )
         return agg2, applied_any
 
+    swap_fn = None
+    if getattr(goal, "uses_swaps", False):
+        from cruise_control_tpu.analyzer.swaps import make_swap_round
+
+        swap_fn = make_swap_round(
+            goal, priors, dims, settings.num_swap_pairs, settings.swap_candidates
+        )
+
     def goal_step(static: StaticCtx, agg: Aggregates):
         def cond(c):
             _, rnd, done = c
@@ -278,6 +248,16 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         def body(c):
             agg_c, rnd, _ = c
             agg2, applied = one_round(static, agg_c)
+            if swap_fn is not None:
+                # swaps only when plain moves stalled, matching the
+                # reference's move-first-then-swap order
+                agg2, swap_applied = jax.lax.cond(
+                    applied,
+                    lambda a: (a, jnp.asarray(False)),
+                    lambda a: swap_fn(static, a),
+                    agg2,
+                )
+                applied = applied | swap_applied
             return (agg2, rnd + 1, ~applied)
 
         final_agg, rounds, _ = jax.lax.while_loop(

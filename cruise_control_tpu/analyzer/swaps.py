@@ -1,0 +1,241 @@
+"""Replica swap search for resource-distribution goals.
+
+The array-native counterpart of ResourceDistributionGoal's swap phase
+(cc/analyzer/goals/ResourceDistributionGoal.java: rebalanceBySwappingLoadOut
+:482 / ...In :610, the INTER_BROKER_REPLICA_SWAP action): when single moves
+can no longer help — the classic deadlock is a hot broker whose every
+candidate move is too big for any destination — exchange a heavy replica on
+an over-limit broker for a light replica on an under-loaded one.
+
+Where the reference walks SortedReplicas views under a 1 s/broker timeout,
+this kernel scores a pruned dense grid in one shot:
+
+  top-N hottest brokers x top-K heaviest movable replicas each
+  paired with the N coldest brokers x their K lightest replicas
+  -> [N, K, K] swap candidates, scored by imbalance reduction and masked by
+  the prior-goal invariants (rack safety for BOTH partitions, capacity and
+  potential-NW_OUT not-worse on both ends, leadership eligibility when a
+  leader slot moves), then applied via a sequentially re-validated scan.
+
+Replica counts are unchanged by a swap, so replica-capacity/distribution
+goals are unaffected by construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import (
+    KIND_MOVE,
+    _follower_vec,
+    _leader_vec,
+    build_selected,
+)
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, apply_action
+from cruise_control_tpu.analyzer.goals.base import SCORE_EPS
+from cruise_control_tpu.common.resources import PartMetric, Resource
+
+
+def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Array:
+    """f32[P, R]: per-slot load contribution for one resource."""
+    pl = static.part_load
+    lead = {
+        Resource.CPU: pl[:, PartMetric.CPU_LEADER],
+        Resource.NW_IN: pl[:, PartMetric.NW_IN_LEADER],
+        Resource.NW_OUT: pl[:, PartMetric.NW_OUT_LEADER],
+        Resource.DISK: pl[:, PartMetric.DISK],
+    }[Resource(res)]
+    foll = {
+        Resource.CPU: pl[:, PartMetric.CPU_FOLLOWER],
+        Resource.NW_IN: pl[:, PartMetric.NW_IN_FOLLOWER],
+        Resource.NW_OUT: jnp.zeros_like(lead),
+        Resource.DISK: pl[:, PartMetric.DISK],
+    }[Resource(res)]
+    r = assignment.shape[1]
+    is_leader = (jnp.arange(r) == 0)[None, :]
+    return jnp.where(is_leader, lead[:, None], foll[:, None])
+
+
+def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
+    """Build swap_round(static, agg) -> (agg, applied_any) for a
+    resource-distribution goal (jit-compatible; call inside the goal loop).
+
+    `priors` are the already-optimized goals: both directions of every
+    candidate swap must pass each prior's acceptance kernel, the same
+    invariant the move path enforces per candidate."""
+    res = goal.resource
+    p_count, r = dims.num_partitions, dims.max_rf
+    n_pairs = max(1, min(n_pairs, dims.num_brokers // 2 or 1))
+    k = max(1, min(k, p_count))
+    priors = tuple(priors)
+
+    def swap_round(static: StaticCtx, agg: Aggregates):
+        gs = goal.prepare(static, agg, dims)
+        cap = jnp.maximum(static.broker_capacity[:, res], 1e-9)
+        util = agg.broker_load[:, res] / cap
+
+        # both ends RECEIVE a replica (mv2 lands on the hot broker), so both
+        # must be eligible destinations; swaps are disabled entirely in
+        # immigrant-only self-healing mode (a swap moves non-immigrants).
+        hot_rank = jnp.where(static.alive & static.replica_dst_ok, util, -jnp.inf)
+        hot_vals, hot = jax.lax.top_k(hot_rank, n_pairs)  # i32[N]
+        cold_rank = jnp.where(static.alive & static.replica_dst_ok, -util, -jnp.inf)
+        cold_vals, cold = jax.lax.top_k(cold_rank, n_pairs)  # i32[N]
+        pair_ok = (
+            jnp.isfinite(hot_vals)[:, None, None]
+            & jnp.isfinite(cold_vals)[:, None, None]
+            & ~static.only_move_immigrants
+        )
+
+        contrib = _slot_contrib(static, agg.assignment, res)  # f32[P, R]
+        movable = static.movable_partition[:, None] & (agg.assignment >= 0)
+
+        def pick(broker, heaviest: bool):
+            mask = (agg.assignment == broker) & movable
+            score = jnp.where(mask, contrib, -jnp.inf if heaviest else jnp.inf)
+            flat = (score if heaviest else -score).reshape(p_count * r)
+            vals, idx = jax.lax.top_k(flat, k)
+            return (
+                (idx // r).astype(jnp.int32),  # partitions
+                (idx % r).astype(jnp.int32),  # slots
+                jnp.where(jnp.isfinite(vals), jnp.abs(vals), jnp.nan),  # loads
+            )
+
+        hp, hs, hl = jax.vmap(lambda b: pick(b, True))(hot)  # [N, K] each
+        cp, cs, cl = jax.vmap(lambda b: pick(b, False))(cold)
+
+        # [N, K, K] swap grid: replica a of hot_i <-> replica b of cold_i
+        delta = hl[:, :, None] - cl[:, None, :]  # load moved hot -> cold
+        ok = jnp.isfinite(delta) & (delta > SCORE_EPS) & pair_ok
+        ok &= hp[:, :, None] != cp[:, None, :]
+
+        # every previously-optimized goal must accept BOTH directions
+        mv1b = build_selected(
+            static.part_load, agg.assignment,
+            hp[:, :, None], jnp.int32(KIND_MOVE), hs[:, :, None], cold[:, None, None],
+        )
+        mv2b = build_selected(
+            static.part_load, agg.assignment,
+            cp[:, None, :], jnp.int32(KIND_MOVE), cs[:, None, :], hot[:, None, None],
+        )
+        for g in priors:
+            pgs = g.prepare(static, agg, dims)
+            ok &= g.acceptance(static, pgs, agg, mv1b)
+            ok &= g.acceptance(static, pgs, agg, mv2b)
+
+        # neither broker may already host the other's partition
+        cold_b = cold[:, None, None]
+        hot_b = hot[:, None, None]
+        ok &= ~jnp.any(agg.assignment[hp[:, :, None]] == cold_b[..., None], axis=-1)
+        ok &= ~jnp.any(agg.assignment[cp[:, None, :]] == hot_b[..., None], axis=-1)
+
+        # rack safety for both directions (RackAwareGoal acceptance)
+        rack_hot = static.broker_rack[hot][:, None, None]
+        rack_cold = static.broker_rack[cold][:, None, None]
+        same_rack = rack_hot == rack_cold
+        cnt1 = agg.rack_replica_count[hp[:, :, None], jnp.broadcast_to(rack_cold, hp[:, :, None].shape)]
+        ok &= (cnt1 - same_rack.astype(cnt1.dtype)) == 0
+        cnt2 = agg.rack_replica_count[cp[:, None, :], jnp.broadcast_to(rack_hot, cp[:, None, :].shape)]
+        ok &= (cnt2 - same_rack.astype(cnt2.dtype)) == 0
+
+        # leadership eligibility when a leader slot changes brokers
+        ok &= (hs[:, :, None] != 0) | static.leadership_dst_ok[cold][:, None, None]
+        ok &= (cs[:, None, :] != 0) | static.leadership_dst_ok[hot][:, None, None]
+
+        # capacity + potential NW_OUT must not get worse on either end
+        # (CapacityGoal / PotentialNwOutGoal acceptance, conservative form)
+        h_load1 = _all_res_contrib(static, agg.assignment, hp, hs)  # [N, K, 4]
+        c_load2 = _all_res_contrib(static, agg.assignment, cp, cs)  # [N, K, 4]
+        hot_before = agg.broker_load[hot][:, None, None, :]
+        cold_before = agg.broker_load[cold][:, None, None, :]
+        hot_after = hot_before - h_load1[:, :, None, :] + c_load2[:, None, :, :]
+        cold_after = cold_before + h_load1[:, :, None, :] - c_load2[:, None, :, :]
+        hot_limit = jnp.maximum(static.capacity_limit[hot][:, None, None, :], hot_before)
+        cold_limit = jnp.maximum(static.capacity_limit[cold][:, None, None, :], cold_before)
+        ok &= jnp.all(hot_after <= hot_limit + 1e-6, axis=-1)
+        ok &= jnp.all(cold_after <= cold_limit + 1e-6, axis=-1)
+        pnw1 = static.part_load[hp, PartMetric.NW_OUT_LEADER][:, :, None]
+        pnw2 = static.part_load[cp, PartMetric.NW_OUT_LEADER][:, None, :]
+        pnw_limit = static.capacity_limit[:, Resource.NW_OUT]
+        cold_pnw_after = agg.potential_nw_out[cold][:, None, None] + pnw1 - pnw2
+        ok &= (cold_pnw_after <= jnp.maximum(pnw_limit[cold][:, None, None],
+                                             agg.potential_nw_out[cold][:, None, None]) + 1e-6)
+        hot_pnw_after = agg.potential_nw_out[hot][:, None, None] - pnw1 + pnw2
+        ok &= (hot_pnw_after <= jnp.maximum(pnw_limit[hot][:, None, None],
+                                            agg.potential_nw_out[hot][:, None, None]) + 1e-6)
+
+        # goal improvement: imbalance reduction of the (hot, cold) pair
+        u_h = util[hot][:, None, None]
+        u_c = util[cold][:, None, None]
+        d_h = delta / cap[hot][:, None, None]
+        d_c = delta / cap[cold][:, None, None]
+        before = _dist(u_h, gs) + _dist(u_c, gs)
+        after = _dist(u_h - d_h, gs) + _dist(u_c + d_c, gs)
+        score = jnp.where(ok & gs.active, before - after, -jnp.inf)
+
+        # best swap per hot/cold pair, applied sequentially with re-validation
+        flat = score.reshape(n_pairs, k * k)
+        best = jnp.argmax(flat, axis=1)
+        best_score = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
+        a_idx = (best // k).astype(jnp.int32)
+        b_idx = (best % k).astype(jnp.int32)
+        rows = jnp.arange(n_pairs)
+        sel = dict(
+            p1=hp[rows, a_idx], s1=hs[rows, a_idx],
+            p2=cp[rows, b_idx], s2=cs[rows, b_idx],
+            hot=hot, cold=cold, score=best_score,
+        )
+
+        def body(carry, i):
+            agg_c, any_applied = carry
+            p1, s1, p2, s2 = sel["p1"][i], sel["s1"][i], sel["p2"][i], sel["s2"][i]
+            h, c = sel["hot"][i], sel["cold"][i]
+            # re-validate against the updated aggregates: both replicas still
+            # on their brokers, swap still improves the pair
+            still = (agg_c.assignment[p1, s1] == h) & (agg_c.assignment[p2, s2] == c)
+            still &= ~jnp.any(agg_c.assignment[p1] == c) & ~jnp.any(agg_c.assignment[p2] == h)
+            # rack safety against the CURRENT rack counts: an earlier swap in
+            # this scan may have placed a sibling replica on the target rack
+            rack_h = static.broker_rack[h]
+            rack_c = static.broker_rack[c]
+            same_rack = (rack_h == rack_c).astype(agg_c.rack_replica_count.dtype)
+            still &= (agg_c.rack_replica_count[p1, rack_c] - same_rack) == 0
+            still &= (agg_c.rack_replica_count[p2, rack_h] - same_rack) == 0
+            u_h2 = agg_c.broker_load[h, res] / cap[h]
+            u_c2 = agg_c.broker_load[c, res] / cap[c]
+            d = contrib[p1, s1] - contrib[p2, s2]
+            improve = (
+                _dist(u_h2, gs) + _dist(u_c2, gs)
+                - _dist(u_h2 - d / cap[h], gs) - _dist(u_c2 + d / cap[c], gs)
+            )
+            apply_flag = jnp.isfinite(sel["score"][i]) & still & (improve > SCORE_EPS)
+            mv1 = build_selected(
+                static.part_load, agg_c.assignment, p1,
+                jnp.int32(KIND_MOVE), s1, c,
+            )
+            agg_c = apply_action(static, agg_c, mv1, apply_flag)
+            mv2 = build_selected(
+                static.part_load, agg_c.assignment, p2,
+                jnp.int32(KIND_MOVE), s2, h,
+            )
+            agg_c = apply_action(static, agg_c, mv2, apply_flag)
+            return (agg_c, any_applied | apply_flag), apply_flag
+
+        (agg2, applied_any), _ = jax.lax.scan(
+            body, (agg, jnp.asarray(False)), jnp.arange(n_pairs)
+        )
+        return agg2, applied_any
+
+    return swap_round
+
+
+def _dist(u, gs):
+    return jnp.maximum(0.0, u - gs.upper) + jnp.maximum(0.0, gs.lower - u)
+
+
+def _all_res_contrib(static: StaticCtx, assignment: jax.Array, p, slot) -> jax.Array:
+    """f32[..., 4]: full per-Resource contribution of replica (p, slot)."""
+    lead = _leader_vec(static.part_load, p)
+    foll = _follower_vec(static.part_load, p)
+    return jnp.where((slot == 0)[..., None], lead, foll)

@@ -1,0 +1,5 @@
+"""Python REST client + CLI (the cruise-control-client analog)."""
+
+from cruise_control_tpu.client.cccli import CruiseControlClient, main
+
+__all__ = ["CruiseControlClient", "main"]

@@ -95,6 +95,10 @@ def _config_def() -> ConfigDef:
              "Upper bound on batched-greedy rounds per goal.")
     d.define("optimizer.candidate.replicas.per.broker", Type.INT, 8, at_least(1), Importance.MEDIUM,
              "Top-k replicas per overloaded broker considered as move sources each round.")
+    d.define("optimizer.swap.broker.pairs", Type.INT, 8, at_least(1), Importance.MEDIUM,
+             "Hot/cold broker pairs examined per swap round when moves stall.")
+    d.define("optimizer.swap.candidate.replicas", Type.INT, 8, at_least(1), Importance.MEDIUM,
+             "Candidate replicas per broker in the swap search grid.")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

@@ -1,0 +1,148 @@
+"""Anomaly detector orchestrator.
+
+Analog of AnomalyDetector (cc/detector/AnomalyDetector.java:46): schedules
+the three detectors at the detection interval, queues anomalies, and runs the
+handler (AnomalyHandlerTask :231) that consults the notifier — FIX calls
+anomaly.fix() through the facade (skipped while the executor is busy, which
+becomes a delayed CHECK), CHECK re-queues after the delay, IGNORE drops.
+Tracks per-type counts for /state."""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cruise_control_tpu.detector.anomalies import (
+    Anomaly,
+    AnomalyNotificationResult,
+    AnomalyType,
+)
+from cruise_control_tpu.detector.detectors import (
+    BrokerFailureDetector,
+    GoalViolationDetector,
+    MetricAnomalyDetector,
+)
+from cruise_control_tpu.detector.notifier import AnomalyNotifier, SelfHealingNotifier
+
+
+@dataclasses.dataclass(frozen=True)
+class AnomalyDetectorConfig:
+    detection_interval_s: float = 300.0  # anomaly.detection.interval.ms
+
+
+class AnomalyDetector:
+    def __init__(
+        self,
+        facade,
+        notifier: Optional[AnomalyNotifier] = None,
+        goal_violation_detector: Optional[GoalViolationDetector] = None,
+        broker_failure_detector: Optional[BrokerFailureDetector] = None,
+        metric_anomaly_detector: Optional[MetricAnomalyDetector] = None,
+        config: AnomalyDetectorConfig = AnomalyDetectorConfig(),
+        clock=time.time,
+    ):
+        self._facade = facade
+        self._notifier = notifier or SelfHealingNotifier()
+        self._gv = goal_violation_detector or GoalViolationDetector(facade)
+        self._bf = broker_failure_detector or BrokerFailureDetector(
+            facade._monitor._metadata, clock=clock
+        )
+        self._ma = metric_anomaly_detector or MetricAnomalyDetector(facade._monitor)
+        self._config = config
+        self._clock = clock
+        self._queue: "queue.Queue[Anomaly]" = queue.Queue()
+        self._counts: Dict[str, int] = {t.name: 0 for t in AnomalyType}
+        self._fixes: Dict[str, int] = {t.name: 0 for t in AnomalyType}
+        self._recent: List[Dict] = []
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- one detection round (callable directly; the loop just schedules it) ---
+
+    def detect_once(self) -> int:
+        """Run all three detectors, queue anomalies; returns queued count."""
+        found: List[Anomaly] = []
+        bf = self._bf.detect()
+        if bf:
+            found.append(bf)
+        gv = self._gv.detect()
+        if gv:
+            found.append(gv)
+        found.extend(self._ma.detect())
+        for a in found:
+            self._counts[a.anomaly_type.name] += 1
+            self._recent.append(a.describe())
+            self._recent = self._recent[-50:]
+            self._queue.put(a)
+        return len(found)
+
+    def handle_once(self, block_s: float = 0.0) -> Optional[str]:
+        """Consume one queued anomaly (AnomalyHandlerTask); returns the action
+        taken ('FIX'/'CHECK'/'IGNORE') or None when the queue is empty."""
+        try:
+            anomaly = self._queue.get(timeout=block_s) if block_s else self._queue.get_nowait()
+        except queue.Empty:
+            return None
+        now_ms = int(self._clock() * 1000)
+        # executor busy => delayed re-check, never a concurrent fix
+        if self._facade._executor.has_ongoing_execution:
+            self._requeue_later(anomaly, delay_s=1.0)
+            return AnomalyNotificationResult.CHECK.name
+        result, delay_s = self._notifier.on_anomaly(anomaly, now_ms)
+        if result == AnomalyNotificationResult.FIX:
+            try:
+                anomaly.fix(self._facade)
+                self._fixes[anomaly.anomaly_type.name] += 1
+            except Exception:
+                pass  # fix failures surface through executor/notifier state
+        elif result == AnomalyNotificationResult.CHECK:
+            self._requeue_later(anomaly, delay_s)
+        return result.name
+
+    def _requeue_later(self, anomaly: Anomaly, delay_s: float) -> None:
+        t = threading.Timer(delay_s, lambda: self._queue.put(anomaly))
+        t.daemon = True
+        t.start()
+
+    # -- background loop -------------------------------------------------------
+
+    def start_detection(self) -> None:
+        """AnomalyDetector.startDetection (:143)."""
+        self._stop.clear()
+
+        def detect_loop():
+            while not self._stop.wait(self._config.detection_interval_s):
+                try:
+                    self.detect_once()
+                except Exception:
+                    pass
+
+        def handle_loop():
+            while not self._stop.is_set():
+                try:
+                    self.handle_once(block_s=1.0)
+                except Exception:
+                    pass
+
+        for fn, name in ((detect_loop, "anomaly-detectors"), (handle_loop, "anomaly-handler")):
+            th = threading.Thread(target=fn, name=name, daemon=True)
+            th.start()
+            self._threads.append(th)
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=5)
+        self._threads.clear()
+
+    def state(self) -> Dict:
+        return {
+            "selfHealingEnabled": self._notifier.self_healing_enabled(),
+            "anomalyCounts": dict(self._counts),
+            "fixesTriggered": dict(self._fixes),
+            "recentAnomalies": list(self._recent),
+            "queuedAnomalies": self._queue.qsize(),
+        }

@@ -1,0 +1,42 @@
+"""Executor subsystem: apply proposals to the live cluster.
+
+Analog of cc/executor/ (SURVEY.md §2f): the Executor drives proposals through
+a ClusterDriver (the ZK/admin bridge SPI) in throttled batches — replica
+movements first, then leadership — with per-broker concurrency caps, a task
+state machine, pluggable movement-ordering strategies, and graceful
+user-triggered stop. Metric sampling pauses during execution, exactly as
+ProposalExecutionRunnable does (cc/executor/Executor.java:546-626).
+"""
+
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+from cruise_control_tpu.executor.strategy import (
+    BaseReplicaMovementStrategy,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    ReplicaMovementStrategy,
+)
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.manager import ExecutionTaskManager
+from cruise_control_tpu.executor.tracker import ExecutionTaskTracker
+from cruise_control_tpu.executor.driver import ClusterDriver, SimulatorClusterDriver
+from cruise_control_tpu.executor.executor import Executor, ExecutorConfig, ExecutorState
+
+__all__ = [
+    "BaseReplicaMovementStrategy",
+    "ClusterDriver",
+    "ExecutionTask",
+    "ExecutionTaskManager",
+    "ExecutionTaskPlanner",
+    "ExecutionTaskTracker",
+    "Executor",
+    "ExecutorConfig",
+    "ExecutorState",
+    "PostponeUrpReplicaMovementStrategy",
+    "PrioritizeLargeReplicaMovementStrategy",
+    "PrioritizeSmallReplicaMovementStrategy",
+    "ReplicaMovementStrategy",
+    "SimulatorClusterDriver",
+    "TaskState",
+    "TaskType",
+]

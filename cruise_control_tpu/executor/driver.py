@@ -1,0 +1,98 @@
+"""Cluster driver SPI — the ZK/admin bridge boundary.
+
+Analog of the Scala ExecutorUtils shim (scala/executor/ExecutorUtils.scala:22:
+write reassignment JSON to ZK, trigger preferred leader election, poll
+progress). Anything that can start a replica movement and report its
+completion can drive the executor; the simulator-backed driver closes the
+loop in-process, with configurable completion latency to exercise the
+executor's polling."""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Tuple
+
+from cruise_control_tpu.executor.task import ExecutionTask, TaskType
+
+
+class ClusterDriver:
+    def start_replica_movement(self, task: ExecutionTask) -> None:
+        """Begin moving replicas for the task's proposal (async)."""
+        raise NotImplementedError
+
+    def start_leadership_movement(self, task: ExecutionTask) -> None:
+        raise NotImplementedError
+
+    def poll(self) -> None:
+        """Advance/refresh driver state (one reassignment-znode poll round)."""
+
+    def is_finished(self, task: ExecutionTask) -> bool:
+        raise NotImplementedError
+
+    def has_ongoing_reassignment(self) -> bool:
+        """Executor refuses to start over an in-progress external
+        reassignment (cc/executor/Executor.java:494)."""
+        return False
+
+
+class SimulatorClusterDriver(ClusterDriver):
+    """Drives a cruise_control_tpu.testing.SimulatedCluster.
+
+    `latency_polls` simulates data-movement time: a movement completes only
+    after that many poll() rounds, forcing the executor through its
+    wait-for-finish loop."""
+
+    def __init__(self, sim, latency_polls: int = 0):
+        self._sim = sim
+        self._latency = latency_polls
+        self._pending: Dict[int, Tuple[ExecutionTask, int]] = {}
+        self._lock = threading.Lock()
+
+    def start_replica_movement(self, task: ExecutionTask) -> None:
+        with self._lock:
+            self._pending[task.execution_id] = (task, self._latency)
+
+    def start_leadership_movement(self, task: ExecutionTask) -> None:
+        with self._lock:
+            self._pending[task.execution_id] = (task, self._latency)
+
+    def poll(self) -> None:
+        with self._lock:
+            for eid, (task, remaining) in list(self._pending.items()):
+                if remaining > 0:
+                    self._pending[eid] = (task, remaining - 1)
+                    continue
+                self._apply(task)
+                del self._pending[eid]
+
+    def _apply(self, task: ExecutionTask) -> None:
+        p = task.proposal
+        if task.task_type == TaskType.INTER_BROKER_REPLICA_ACTION:
+            removed = list(p.replicas_to_remove)
+            adds = list(p.replicas_to_add)
+            for i, dst in enumerate(adds):
+                if i < len(removed):
+                    self._sim.apply_movement(p.partition, removed[i], dst)
+                else:
+                    self._sim.add_replica(p.partition, dst)  # RF increase
+            for src in removed[len(adds):]:  # RF decrease
+                self._sim.remove_replica(p.partition, src)
+            if p.has_leader_action:
+                self._sim.apply_leadership(p.partition, p.new_leader)
+        else:
+            self._sim.apply_leadership(p.partition, p.new_leader)
+
+    def is_finished(self, task: ExecutionTask) -> bool:
+        with self._lock:
+            if task.execution_id in self._pending:
+                return False
+        p = task.proposal
+        if task.task_type == TaskType.LEADER_ACTION:
+            return self._sim.leader_of(p.partition) == p.new_leader
+        return all(self._sim.has_partition(p.partition, b) for b in p.replicas_to_add) and not any(
+            self._sim.has_partition(p.partition, b) for b in p.replicas_to_remove
+        )
+
+    def has_ongoing_reassignment(self) -> bool:
+        with self._lock:
+            return bool(self._pending)

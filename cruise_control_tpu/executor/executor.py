@@ -1,0 +1,250 @@
+"""The Executor: proposal execution lifecycle.
+
+Analog of cc/executor/Executor.java:58. `execute_proposals` (:288) registers
+tasks and runs the execution loop (ProposalExecutionRunnable.execute
+:546-626): pause metric sampling, drive inter-broker replica movements in
+throttled batches through the ClusterDriver, then leadership movements, poll
+until finished, resume sampling. Supports dynamic concurrency changes,
+user-triggered graceful stop (:433), an ExecutorNotifier hook, and the
+recently-removed/demoted broker history (:234-267)."""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor.driver import ClusterDriver
+from cruise_control_tpu.executor.manager import ExecutionTaskManager
+from cruise_control_tpu.executor.planner import ExecutionTaskPlanner
+from cruise_control_tpu.executor.strategy import ReplicaMovementStrategy
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecutorConfig:
+    """Defaults mirror config/cruisecontrol.properties."""
+
+    num_concurrent_partition_movements_per_broker: int = 10
+    num_concurrent_leader_movements: int = 1000
+    execution_progress_check_interval_s: float = 0.01
+    max_execution_polls: int = 100_000
+    #: how long removed/demoted broker ids stay in history
+    removal_history_retention_s: float = 3600.0
+
+
+class ExecutorState:
+    NO_TASK_IN_PROGRESS = "NO_TASK_IN_PROGRESS"
+    STARTING_EXECUTION = "STARTING_EXECUTION"
+    INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS = "INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS"
+    LEADER_MOVEMENT_TASK_IN_PROGRESS = "LEADER_MOVEMENT_TASK_IN_PROGRESS"
+    STOPPING_EXECUTION = "STOPPING_EXECUTION"
+
+
+class ExecutionStoppedException(Exception):
+    pass
+
+
+class Executor:
+    def __init__(
+        self,
+        driver: ClusterDriver,
+        config: ExecutorConfig = ExecutorConfig(),
+        load_monitor=None,
+        notifier: Optional[Callable[[str, Dict], None]] = None,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._driver = driver
+        self._config = config
+        self._monitor = load_monitor
+        self._notifier = notifier or (lambda event, info: None)
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._state = ExecutorState.NO_TASK_IN_PROGRESS
+        self._stop_requested = threading.Event()
+        self._manager = ExecutionTaskManager(
+            config.num_concurrent_partition_movements_per_broker,
+            config.num_concurrent_leader_movements,
+        )
+        self._planner = ExecutionTaskPlanner()
+        self._removed_brokers: Dict[int, float] = {}
+        self._demoted_brokers: Dict[int, float] = {}
+
+    # -- state -----------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def has_ongoing_execution(self) -> bool:
+        with self._lock:
+            return self._state not in (ExecutorState.NO_TASK_IN_PROGRESS,)
+
+    def state_summary(self) -> Dict:
+        return {
+            "state": self.state,
+            **self._manager.tracker.summary(),
+            "recentlyRemovedBrokers": sorted(self.recently_removed_brokers),
+            "recentlyDemotedBrokers": sorted(self.recently_demoted_brokers),
+        }
+
+    def user_triggered_stop_execution(self) -> None:
+        """Graceful stop (Executor.userTriggeredStopExecution :433)."""
+        with self._lock:
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                self._state = ExecutorState.STOPPING_EXECUTION
+        self._stop_requested.set()
+
+    def set_concurrency(self, per_broker: int = None, leadership: int = None) -> None:
+        self._manager.set_concurrency(per_broker, leadership)
+
+    # -- broker history --------------------------------------------------------
+
+    def _gc_history(self, history: Dict[int, float]) -> None:
+        cutoff = self._clock() - self._config.removal_history_retention_s
+        for b in [b for b, t in history.items() if t < cutoff]:
+            del history[b]
+
+    @property
+    def recently_removed_brokers(self) -> Set[int]:
+        with self._lock:
+            self._gc_history(self._removed_brokers)
+            return set(self._removed_brokers)
+
+    @property
+    def recently_demoted_brokers(self) -> Set[int]:
+        with self._lock:
+            self._gc_history(self._demoted_brokers)
+            return set(self._demoted_brokers)
+
+    # -- execution -------------------------------------------------------------
+
+    def execute_proposals(
+        self,
+        proposals: Sequence[ExecutionProposal],
+        strategy: Optional[ReplicaMovementStrategy] = None,
+        urp: Optional[Set[int]] = None,
+        removed_brokers: Optional[Set[int]] = None,
+        demoted_brokers: Optional[Set[int]] = None,
+    ) -> Dict:
+        """Synchronous execution loop; the async layer wraps this in an
+        OperationFuture thread. Returns the execution summary."""
+        with self._lock:
+            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+                raise RuntimeError("an execution is already in progress")
+            if self._driver.has_ongoing_reassignment():
+                raise RuntimeError("ongoing partition reassignment detected; refusing to start")
+            self._state = ExecutorState.STARTING_EXECUTION
+            self._stop_requested.clear()
+            now = self._clock()
+            for b in removed_brokers or ():
+                self._removed_brokers[b] = now
+            for b in demoted_brokers or ():
+                self._demoted_brokers[b] = now
+
+        self._notifier("execution_started", {"numProposals": len(proposals)})
+        if self._monitor is not None:
+            self._monitor.pause_metric_sampling("proposal execution")
+        try:
+            self._manager.tracker.reset()  # summaries are per execution
+            self._planner.clear()
+            self._planner.add_execution_proposals(proposals, strategy=strategy, urp=urp)
+            self._run_replica_movements()
+            self._run_leadership_movements()
+            summary = self._manager.tracker.summary()
+            stopped = self._stop_requested.is_set()
+            self._notifier(
+                "execution_stopped" if stopped else "execution_finished", summary
+            )
+            return {**summary, "stopped": stopped}
+        finally:
+            if self._monitor is not None:
+                self._monitor.resume_metric_sampling()
+            with self._lock:
+                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+
+    def _reap_finished(self, pending: List[ExecutionTask]) -> List[ExecutionTask]:
+        """Poll the driver once and complete any finished tasks."""
+        self._driver.poll()
+        now_ms = int(self._clock() * 1000)
+        still = []
+        for t in pending:
+            if self._driver.is_finished(t):
+                t.completed(now_ms)
+                self._manager.mark_done(t)
+            else:
+                still.append(t)
+        return still
+
+    def _wait_for_tasks(self, tasks: List[ExecutionTask]) -> None:
+        polls = 0
+        pending = list(tasks)
+        while pending:
+            pending = self._reap_finished(pending)
+            if not pending:
+                break
+            polls += 1
+            if polls > self._config.max_execution_polls:
+                now_ms = int(self._clock() * 1000)
+                for t in pending:
+                    t.kill(now_ms)
+                    self._manager.mark_done(t)
+                raise TimeoutError(f"{len(pending)} execution task(s) never finished")
+            # graceful stop still waits for in-flight work — at normal pace,
+            # not a busy spin
+            time.sleep(self._config.execution_progress_check_interval_s)
+
+    def _run_replica_movements(self) -> None:
+        """Pipelined execution: broker slots refill as individual tasks
+        finish, so one slow movement never stalls unrelated brokers
+        (the reference refills per poll round the same way)."""
+        with self._lock:
+            self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        in_flight: List[ExecutionTask] = []
+        polls = 0
+        while True:
+            in_flight = self._reap_finished(in_flight)
+            remaining = self._planner.remaining_inter_broker_replica_movements
+            if self._stop_requested.is_set():
+                if not in_flight:
+                    break  # graceful: nothing new once stop is requested
+            elif remaining:
+                brokers = set()
+                for t in remaining:
+                    brokers |= t.involved_brokers
+                slots = self._manager.available_slots(brokers)
+                batch = self._planner.get_inter_broker_replica_movement_tasks(slots)
+                if batch:
+                    now_ms = int(self._clock() * 1000)
+                    self._manager.mark_in_progress(batch, now_ms)
+                    for t in batch:
+                        self._driver.start_replica_movement(t)
+                    in_flight.extend(batch)
+            elif not in_flight:
+                break
+            if in_flight:
+                polls += 1
+                if polls > self._config.max_execution_polls:
+                    now_ms = int(self._clock() * 1000)
+                    for t in in_flight:
+                        t.kill(now_ms)
+                        self._manager.mark_done(t)
+                    raise TimeoutError(f"{len(in_flight)} execution task(s) never finished")
+                time.sleep(self._config.execution_progress_check_interval_s)
+
+    def _run_leadership_movements(self) -> None:
+        with self._lock:
+            self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+        while not self._stop_requested.is_set():
+            batch = self._planner.get_leadership_movement_tasks(self._manager.leadership_cap)
+            if not batch:
+                break
+            now_ms = int(self._clock() * 1000)
+            self._manager.mark_in_progress(batch, now_ms)
+            for t in batch:
+                self._driver.start_leadership_movement(t)
+            self._wait_for_tasks(batch)

@@ -1,0 +1,89 @@
+"""Execution task state machine.
+
+Analog of ExecutionTask (cc/executor/ExecutionTask.java:41):
+
+    PENDING --> IN_PROGRESS --> COMPLETED
+                     |--> ABORTING --> ABORTED
+                     |--> ABORTING --> DEAD
+                     |--> DEAD
+
+with the same valid-transition table (:55-60).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+
+class TaskType(enum.IntEnum):
+    INTER_BROKER_REPLICA_ACTION = 0
+    LEADER_ACTION = 1
+
+
+class TaskState(enum.IntEnum):
+    PENDING = 0
+    IN_PROGRESS = 1
+    ABORTING = 2
+    ABORTED = 3
+    DEAD = 4
+    COMPLETED = 5
+
+
+_VALID_TRANSFER = {
+    TaskState.PENDING: {TaskState.IN_PROGRESS},
+    TaskState.IN_PROGRESS: {TaskState.ABORTING, TaskState.DEAD, TaskState.COMPLETED},
+    TaskState.ABORTING: {TaskState.ABORTED, TaskState.DEAD},
+    TaskState.COMPLETED: set(),
+    TaskState.DEAD: set(),
+    TaskState.ABORTED: set(),
+}
+
+
+@dataclasses.dataclass
+class ExecutionTask:
+    execution_id: int
+    proposal: ExecutionProposal
+    task_type: TaskType
+    state: TaskState = TaskState.PENDING
+    start_time_ms: Optional[int] = None
+    end_time_ms: Optional[int] = None
+
+    def _transfer(self, target: TaskState) -> None:
+        if target not in _VALID_TRANSFER[self.state]:
+            raise ValueError(f"illegal transition {self.state.name} -> {target.name}")
+        self.state = target
+
+    def in_progress(self, now_ms: int = 0) -> None:
+        self._transfer(TaskState.IN_PROGRESS)
+        self.start_time_ms = now_ms
+
+    def completed(self, now_ms: int = 0) -> None:
+        self._transfer(TaskState.COMPLETED)
+        self.end_time_ms = now_ms
+
+    def abort(self) -> None:
+        self._transfer(TaskState.ABORTING)
+
+    def aborted(self, now_ms: int = 0) -> None:
+        self._transfer(TaskState.ABORTED)
+        self.end_time_ms = now_ms
+
+    def kill(self, now_ms: int = 0) -> None:
+        self._transfer(TaskState.DEAD)
+        self.end_time_ms = now_ms
+
+    @property
+    def done(self) -> bool:
+        return self.state in (TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD)
+
+    #: brokers whose in-flight budget this task consumes (source + destination)
+    @property
+    def involved_brokers(self):
+        p = self.proposal
+        if self.task_type == TaskType.LEADER_ACTION:
+            return {p.old_leader, p.new_leader}
+        return set(p.replicas_to_add) | set(p.replicas_to_remove)

@@ -1,0 +1,45 @@
+"""Task-state aggregation for observability.
+
+Analog of ExecutionTaskTracker (cc/executor/ExecutionTaskTracker.java):
+counts by (type, state) for the /state endpoint and sensors."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+
+
+class ExecutionTaskTracker:
+    def __init__(self):
+        self._latest: Dict[int, ExecutionTask] = {}
+
+    def observe(self, task: ExecutionTask) -> None:
+        self._latest[task.execution_id] = task
+
+    def reset(self) -> None:
+        """Drop prior-execution tasks (summaries are per execution; without
+        this, a long-lived service accumulates every task ever run)."""
+        self._latest.clear()
+
+    def counts(self) -> Dict[str, Dict[str, int]]:
+        out = {
+            t.name: {s.name: 0 for s in TaskState} for t in TaskType
+        }
+        for task in self._latest.values():
+            out[task.task_type.name][task.state.name] += 1
+        return out
+
+    def summary(self) -> Dict[str, int]:
+        c = self.counts()
+        return {
+            "numTotalMovements": sum(sum(v.values()) for v in c.values()),
+            "numFinishedMovements": sum(
+                v[TaskState.COMPLETED.name] + v[TaskState.ABORTED.name] + v[TaskState.DEAD.name]
+                for v in c.values()
+            ),
+            "numInProgressMovements": sum(v[TaskState.IN_PROGRESS.name] for v in c.values()),
+            "numAbortedOrDead": sum(
+                v[TaskState.ABORTED.name] + v[TaskState.DEAD.name] for v in c.values()
+            ),
+        }

@@ -1,0 +1,126 @@
+"""Service entry point.
+
+Analog of KafkaCruiseControlMain (cc/KafkaCruiseControlMain.java:25): load
+config, wire monitor + analyzer + executor + detector behind the facade,
+start background loops (sampling, proposal precompute, anomaly detection),
+and serve the REST API.
+
+The cluster backend is pluggable: with no real Kafka in reach, the default
+wiring runs against the in-process simulator (a seeded synthetic cluster) so
+the full service loop is demonstrable end to end:
+
+    python -m cruise_control_tpu.main --port 9090 --simulate-brokers 12
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+
+def build_simulated_service(
+    num_brokers: int = 12,
+    num_racks: int = 4,
+    num_topics: int = 20,
+    seed: int = 42,
+    window_s: float = 5.0,
+    two_step_verification: bool = False,
+):
+    """Wire the full stack over a simulated cluster; returns (app, parts)."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.async_ops import AsyncCruiseControl
+    from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
+    from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+    from cruise_control_tpu.facade import CruiseControl, FacadeConfig
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+    from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+    from cruise_control_tpu.monitor.task_runner import LoadMonitorTaskRunner
+    from cruise_control_tpu.reporter import MetricsReporter, MetricsReporterConfig
+    from cruise_control_tpu.reporter.transport import InMemoryTransport
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+    from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+    truth = random_cluster(
+        seed,
+        ClusterProperty(
+            num_racks=num_racks, num_brokers=num_brokers, num_topics=num_topics,
+            replication_factor=min(3, num_racks),
+        ),
+    )
+    sim = SimulatedCluster(truth)
+    transport = InMemoryTransport()
+    reporters = [
+        MetricsReporter(
+            i, sim.metric_source(i), transport,
+            MetricsReporterConfig(reporting_interval_s=window_s / 3),
+        )
+        for i in range(num_brokers)
+    ]
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=window_s),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(
+            window_ms=int(window_s * 1000), num_windows=5, min_samples_per_window=1,
+            sampling_interval_s=window_s / 2,
+        ),
+    )
+    runner = LoadMonitorTaskRunner(monitor)
+    executor = Executor(SimulatorClusterDriver(sim, latency_polls=2), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor, optimizer=GoalOptimizer(),
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    acc = AsyncCruiseControl(facade)
+    detector = AnomalyDetector(facade, notifier=SelfHealingNotifier())
+    app = CruiseControlApp(
+        acc, anomaly_detector=detector, two_step_verification=two_step_verification
+    )
+    parts = {
+        "sim": sim, "reporters": reporters, "monitor": monitor, "runner": runner,
+        "executor": executor, "facade": facade, "acc": acc, "detector": detector,
+    }
+    return app, parts
+
+
+def start_background(parts, precompute_interval_s: float = 30.0,
+                     detection_interval_s: float = 60.0) -> None:
+    for r in parts["reporters"]:
+        r.start()
+    parts["runner"].start()
+    parts["acc"].start_proposal_precompute(interval_s=precompute_interval_s)
+    parts["detector"]._config = type(parts["detector"]._config)(
+        detection_interval_s=detection_interval_s
+    )
+    parts["detector"].start_detection()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="cruise-control-tpu")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=9090)
+    parser.add_argument("--simulate-brokers", type=int, default=12)
+    parser.add_argument("--simulate-topics", type=int, default=20)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--two-step-verification", action="store_true")
+    args = parser.parse_args(argv)
+
+    from cruise_control_tpu.servlet.server import run_server
+
+    app, parts = build_simulated_service(
+        num_brokers=args.simulate_brokers, num_topics=args.simulate_topics,
+        seed=args.seed, two_step_verification=args.two_step_verification,
+    )
+    start_background(parts)
+    print(f"cruise-control-tpu serving on http://{args.host}:{args.port}/kafkacruisecontrol/state")
+    run_server(app, host=args.host, port=args.port)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,427 @@
+"""HTTP server: the 19-endpoint REST surface.
+
+Analog of KafkaCruiseControlServlet (cc/servlet/KafkaCruiseControlServlet.java:76)
++ KafkaCruiseControlMain's Jetty bootstrap, on aiohttp. Endpoint set matches
+cc/servlet/EndPoint.java:38-57:
+
+  GET  state, load, partition_load, proposals, kafka_cluster_state,
+       user_tasks, review_board, bootstrap, train
+  POST rebalance, add_broker, remove_broker, demote_broker,
+       stop_proposal_execution, pause_sampling, resume_sampling,
+       topic_configuration, admin, review
+
+Long operations return a `User-Task-ID` header; polling the same endpoint
+with that id (or the same session cookie) attaches to the in-flight task and
+returns progress until the result is ready — the reference's async contract
+(cc/async/, UserTaskManager).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+from typing import Dict, Optional
+
+import numpy as np
+from aiohttp import web
+
+from cruise_control_tpu.analyzer.stats import stats_to_dict
+from cruise_control_tpu.async_ops import AsyncCruiseControl, OperationFuture
+from cruise_control_tpu.common.resources import BrokerState, PartMetric, Resource
+from cruise_control_tpu.facade import IllegalRequestException
+from cruise_control_tpu.servlet.purgatory import Purgatory
+from cruise_control_tpu.servlet.user_tasks import UserTaskManager
+
+PREFIX = "/kafkacruisecontrol"
+
+#: POST endpoints subject to 2-step verification when enabled
+REVIEWABLE = {
+    "rebalance", "add_broker", "remove_broker", "demote_broker",
+    "topic_configuration", "admin",
+}
+
+
+def _bool(request, name: str, default: bool = False) -> bool:
+    v = request.query.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("true", "1", "yes")
+
+
+def _goals(request) -> Optional[list]:
+    g = request.query.get("goals")
+    return [s for s in g.split(",") if s] if g else None
+
+
+def _brokerids(request) -> set:
+    raw = request.query.get("brokerid", "")
+    if not raw:
+        raise IllegalRequestException("brokerid parameter is required")
+    return {int(b) for b in raw.split(",")}
+
+
+class CruiseControlApp:
+    """Wires the facade + async layer + task manager into an aiohttp app."""
+
+    def __init__(
+        self,
+        async_cc: AsyncCruiseControl,
+        anomaly_detector=None,
+        two_step_verification: bool = False,
+        response_wait_s: float = 1.0,
+    ):
+        self._acc = async_cc
+        self._facade = async_cc.facade
+        self._detector = anomaly_detector
+        self._tasks = UserTaskManager()
+        self._purgatory = Purgatory() if two_step_verification else None
+        self._two_step = two_step_verification
+        self._wait_s = response_wait_s
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _json(self, payload, status: int = 200, headers: Optional[Dict] = None):
+        return web.json_response(
+            payload, status=status, headers=headers or {},
+            dumps=lambda o: json.dumps(o, default=str),
+        )
+
+    async def _async_op(self, request, endpoint: str, factory) -> web.Response:
+        """Run/attach a long op; 200 + result when done within the wait
+        budget, else 202 + progress with the User-Task-ID header."""
+        user_task_id = request.headers.get("User-Task-ID") or request.query.get("user_task_id")
+        session_key = request.headers.get("X-Session") or request.remote or ""
+        try:
+            tid, future = self._tasks.get_or_create_task(
+                endpoint, factory, user_task_id, session_key + ":" + endpoint
+            )
+        except KeyError as e:
+            return self._json({"errorMessage": str(e)}, status=404)
+        deadline = asyncio.get_event_loop().time() + self._wait_s
+        while not future.done() and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        headers = {"User-Task-ID": tid}
+        if not future.done():
+            return self._json(
+                {"progress": future.describe()}, status=202, headers=headers
+            )
+        exc = future.exception()
+        if exc is not None:
+            status = 400 if isinstance(exc, IllegalRequestException) else 500
+            return self._json({"errorMessage": str(exc)}, status=status, headers=headers)
+        return self._json(self._render_result(future.result()), headers=headers)
+
+    def _render_result(self, result) -> Dict:
+        if hasattr(result, "summary"):
+            out = result.summary()
+            out["proposals"] = [p.to_dict() for p in result.proposals[:10_000]]
+            return out
+        return result if isinstance(result, dict) else {"result": str(result)}
+
+    def _maybe_park(self, request, endpoint: str) -> Optional[web.Response]:
+        """2-step verification gate for reviewable POSTs."""
+        if not self._two_step or endpoint not in REVIEWABLE:
+            return None
+        if request.headers.get("User-Task-ID") or request.query.get("user_task_id"):
+            return None  # polling an already-submitted task, not a new request
+        rid = request.query.get("review_id")
+        if rid is None:
+            review_id = self._purgatory.add_request(endpoint, dict(request.query))
+            return self._json(
+                {"reviewId": review_id, "status": "PENDING_REVIEW",
+                 "message": "approve via POST /review and re-submit with review_id"}
+            )
+        try:
+            self._purgatory.submit(int(rid))
+        except (KeyError, ValueError) as e:
+            return self._json({"errorMessage": str(e)}, status=400)
+        return None
+
+    # -- GET endpoints ---------------------------------------------------------
+
+    async def state(self, request) -> web.Response:
+        out = self._facade.state()
+        if self._detector is not None:
+            out["AnomalyDetectorState"] = self._detector.state()
+        return self._json(out)
+
+    async def load(self, request) -> web.Response:
+        try:
+            return self._json(self._facade._monitor.broker_stats())
+        except ValueError as e:
+            return self._json({"errorMessage": str(e)}, status=503)
+
+    async def partition_load(self, request) -> web.Response:
+        resource = request.query.get("resource", "DISK").upper()
+        try:
+            res = Resource[resource]
+        except KeyError:
+            return self._json({"errorMessage": f"unknown resource {resource}"}, status=400)
+        try:
+            model, meta = self._facade._monitor.cluster_model()
+        except ValueError as e:
+            return self._json({"errorMessage": str(e)}, status=503)
+        pl = np.asarray(model.part_load)
+        col = {
+            Resource.CPU: pl[:, PartMetric.CPU_LEADER],
+            Resource.NW_IN: pl[:, PartMetric.NW_IN_LEADER],
+            Resource.NW_OUT: pl[:, PartMetric.NW_OUT_LEADER],
+            Resource.DISK: pl[:, PartMetric.DISK],
+        }[res]
+        n = min(int(request.query.get("entries", "100")), col.shape[0])
+        order = np.argsort(-col)[:n]
+        a = np.asarray(model.assignment)
+        return self._json(
+            {
+                "records": [
+                    {
+                        "topicPartition": meta.topic_partition(int(p)),
+                        "leader": int(a[p, 0]),
+                        "followers": [int(b) for b in a[p, 1:] if b >= 0],
+                        resource: float(col[p]),
+                    }
+                    for p in order
+                ]
+            }
+        )
+
+    async def proposals(self, request) -> web.Response:
+        goals = _goals(request)
+        ignore_cache = _bool(request, "ignore_proposal_cache")
+        return await self._async_op(
+            request, "proposals",
+            lambda: self._acc.get_proposals(goal_names=goals, ignore_proposal_cache=ignore_cache),
+        )
+
+    async def kafka_cluster_state(self, request) -> web.Response:
+        topo = self._facade._monitor._metadata.refresh_metadata()
+        a = np.asarray(topo.assignment)
+        leaders = a[:, 0]
+        out_brokers = []
+        for i in range(topo.num_brokers):
+            out_brokers.append(
+                {
+                    "Broker": int(topo.broker_ids[i]),
+                    "BrokerState": BrokerState(int(topo.broker_state[i])).name,
+                    "Rack": int(topo.broker_rack[i]),
+                    "Leaders": int((leaders == i).sum()),
+                    "Replicas": int((a == i).sum()),
+                }
+            )
+        verbose = _bool(request, "verbose")
+        out = {"KafkaBrokerState": out_brokers}
+        if verbose:
+            out["KafkaPartitionState"] = [
+                {
+                    "topicPartition": f"{topo.topic_names[topo.topic_id[p]]}-{int(topo.partition_index[p])}",
+                    "leader": int(a[p, 0]),
+                    "replicas": [int(b) for b in a[p] if b >= 0],
+                }
+                for p in range(topo.num_partitions)
+            ]
+        return self._json(out)
+
+    async def user_tasks(self, request) -> web.Response:
+        return self._json({"userTasks": self._tasks.describe_all()})
+
+    async def review_board(self, request) -> web.Response:
+        if self._purgatory is None:
+            return self._json({"errorMessage": "2-step verification is disabled"}, status=400)
+        return self._json(self._purgatory.review_board())
+
+    async def bootstrap(self, request) -> web.Response:
+        """Replay the sample store into the aggregators (BootstrapTask analog)."""
+        monitor = self._facade._monitor
+        part, brok = monitor._store.load_samples()
+        n = monitor.bootstrap(
+            __import__("cruise_control_tpu.monitor.sampler", fromlist=["Samples"]).Samples(part, brok)
+        )
+        return self._json({"bootstrappedSamples": n, "state": monitor.state})
+
+    async def train(self, request) -> web.Response:
+        """Train the linear-regression CPU model from broker windows
+        (LoadMonitorTaskRunner.train analog)."""
+        from cruise_control_tpu.models import model_utils
+        from cruise_control_tpu.monitor.metricdef import KafkaMetricDef
+
+        monitor = self._facade._monitor
+        try:
+            agg = monitor._broker_agg.aggregate()
+        except ValueError as e:
+            return self._json({"errorMessage": str(e)}, status=503)
+        vals = agg.values  # [B, W, M]
+        params = model_utils.LinearRegressionModelParameters()
+        for b in range(vals.shape[0]):
+            for w in range(vals.shape[1]):
+                cpu = float(vals[b, w, KafkaMetricDef.CPU_USAGE])
+                if cpu <= 0:
+                    continue
+                params.add_observation(
+                    cpu / 100.0,
+                    float(vals[b, w, KafkaMetricDef.LEADER_BYTES_IN]),
+                    float(vals[b, w, KafkaMetricDef.LEADER_BYTES_OUT]),
+                    float(vals[b, w, KafkaMetricDef.REPLICATION_BYTES_IN_RATE]),
+                )
+        coef = params.train()
+        return self._json(
+            {
+                "trained": coef is not None,
+                "observations": params.num_observations,
+                "coefficients": None if coef is None else [float(c) for c in coef],
+            }
+        )
+
+    # -- POST endpoints --------------------------------------------------------
+
+    async def rebalance(self, request) -> web.Response:
+        parked = self._maybe_park(request, "rebalance")
+        if parked is not None:
+            return parked
+        goals = _goals(request)
+        dryrun = _bool(request, "dryrun", True)
+        skip_hard = _bool(request, "skip_hard_goal_check")
+        return await self._async_op(
+            request, "rebalance",
+            lambda: self._acc.rebalance(
+                goal_names=goals, dryrun=dryrun, skip_hard_goal_check=skip_hard
+            ),
+        )
+
+    async def add_broker(self, request) -> web.Response:
+        parked = self._maybe_park(request, "add_broker")
+        if parked is not None:
+            return parked
+        try:
+            brokers = _brokerids(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
+        dryrun = _bool(request, "dryrun", True)
+        return await self._async_op(
+            request, "add_broker", lambda: self._acc.add_brokers(brokers, dryrun=dryrun)
+        )
+
+    async def remove_broker(self, request) -> web.Response:
+        parked = self._maybe_park(request, "remove_broker")
+        if parked is not None:
+            return parked
+        try:
+            brokers = _brokerids(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
+        dryrun = _bool(request, "dryrun", True)
+        return await self._async_op(
+            request, "remove_broker",
+            lambda: self._acc.decommission_brokers(brokers, dryrun=dryrun),
+        )
+
+    async def demote_broker(self, request) -> web.Response:
+        parked = self._maybe_park(request, "demote_broker")
+        if parked is not None:
+            return parked
+        try:
+            brokers = _brokerids(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
+        dryrun = _bool(request, "dryrun", True)
+        return await self._async_op(
+            request, "demote_broker",
+            lambda: self._acc.demote_brokers(brokers, dryrun=dryrun),
+        )
+
+    async def stop_proposal_execution(self, request) -> web.Response:
+        self._facade._executor.user_triggered_stop_execution()
+        return self._json({"message": "execution stop requested"})
+
+    async def pause_sampling(self, request) -> web.Response:
+        self._facade._monitor.pause_metric_sampling(request.query.get("reason", "user request"))
+        return self._json({"message": "sampling paused"})
+
+    async def resume_sampling(self, request) -> web.Response:
+        self._facade._monitor.resume_metric_sampling()
+        return self._json({"message": "sampling resumed"})
+
+    async def topic_configuration(self, request) -> web.Response:
+        parked = self._maybe_park(request, "topic_configuration")
+        if parked is not None:
+            return parked
+        pattern = request.query.get("topic")
+        rf = request.query.get("replication_factor")
+        if not pattern or not rf:
+            return self._json(
+                {"errorMessage": "topic and replication_factor are required"}, status=400
+            )
+        dryrun = _bool(request, "dryrun", True)
+        return await self._async_op(
+            request, "topic_configuration",
+            lambda: self._acc.submit(
+                "TOPIC_CONFIGURATION",
+                self._facade.update_topic_replication_factor,
+                pattern, int(rf), dryrun,
+            ),
+        )
+
+    async def admin(self, request) -> web.Response:
+        parked = self._maybe_park(request, "admin")
+        if parked is not None:
+            return parked
+        out = {}
+        pb = request.query.get("concurrent_partition_movements_per_broker")
+        lm = request.query.get("concurrent_leader_movements")
+        if pb or lm:
+            self._facade._executor.set_concurrency(
+                per_broker=int(pb) if pb else None, leadership=int(lm) if lm else None
+            )
+            out["concurrencyUpdated"] = True
+        if self._detector is not None:
+            enable = request.query.get("enable_self_healing_for")
+            disable = request.query.get("disable_self_healing_for")
+            notifier = self._detector._notifier
+            for name, value in ((enable, True), (disable, False)):
+                if name:
+                    attr = f"self_healing_{name.lower()}_enabled"
+                    if hasattr(notifier, attr):
+                        object.__setattr__(notifier, attr, value)
+                        out[f"selfHealing:{name}"] = value
+        return self._json(out or {"message": "no admin action taken"})
+
+    async def review(self, request) -> web.Response:
+        if self._purgatory is None:
+            return self._json({"errorMessage": "2-step verification is disabled"}, status=400)
+        approve = [int(x) for x in request.query.get("approve", "").split(",") if x]
+        discard = [int(x) for x in request.query.get("discard", "").split(",") if x]
+        try:
+            return self._json(
+                self._purgatory.apply_review(approve, discard, request.query.get("reason", ""))
+            )
+        except (KeyError, ValueError) as e:
+            return self._json({"errorMessage": str(e)}, status=400)
+
+    # -- app wiring ------------------------------------------------------------
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        g = [
+            ("state", self.state), ("load", self.load),
+            ("partition_load", self.partition_load), ("proposals", self.proposals),
+            ("kafka_cluster_state", self.kafka_cluster_state),
+            ("user_tasks", self.user_tasks), ("review_board", self.review_board),
+            ("bootstrap", self.bootstrap), ("train", self.train),
+        ]
+        p = [
+            ("rebalance", self.rebalance), ("add_broker", self.add_broker),
+            ("remove_broker", self.remove_broker), ("demote_broker", self.demote_broker),
+            ("stop_proposal_execution", self.stop_proposal_execution),
+            ("pause_sampling", self.pause_sampling), ("resume_sampling", self.resume_sampling),
+            ("topic_configuration", self.topic_configuration), ("admin", self.admin),
+            ("review", self.review),
+        ]
+        for name, handler in g:
+            app.router.add_get(f"{PREFIX}/{name}", handler)
+        for name, handler in p:
+            app.router.add_post(f"{PREFIX}/{name}", handler)
+        return app
+
+
+def run_server(app: CruiseControlApp, host: str = "127.0.0.1", port: int = 9090) -> None:
+    web.run_app(app.build_app(), host=host, port=port)

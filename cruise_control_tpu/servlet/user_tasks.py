@@ -1,0 +1,121 @@
+"""User task management.
+
+Analog of UserTaskManager (cc/servlet/UserTaskManager.java:60): long requests
+get a UUID (returned as the User-Task-ID header); re-requesting with the same
+id (or same session + endpoint) returns the in-flight/completed future
+instead of starting a duplicate. Completed tasks are retained for a bounded
+time and count."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid as uuid_mod
+from typing import Callable, Dict, List, Optional, Tuple
+
+from cruise_control_tpu.async_ops import OperationFuture
+
+
+class UserTaskManager:
+    def __init__(
+        self,
+        max_active_tasks: int = 25,
+        completed_retention_s: float = 86_400.0,
+        max_retained_tasks: int = 500,
+        clock: Callable[[], float] = time.time,
+        uuid_factory: Callable[[], str] = lambda: str(uuid_mod.uuid4()),
+    ):
+        self._max_active = max_active_tasks
+        self._retention_s = completed_retention_s
+        self._max_retained = max_retained_tasks
+        self._clock = clock
+        self._uuid = uuid_factory
+        self._lock = threading.Lock()
+        self._tasks: Dict[str, Dict] = {}  # id -> {future, endpoint, created, session}
+        self._by_session: Dict[Tuple[str, str], str] = {}  # (session, endpoint) -> id
+
+    def _gc(self) -> None:
+        now = self._clock()
+        done = [
+            (tid, t) for tid, t in self._tasks.items() if t["future"].done()
+        ]
+        for tid, t in done:
+            if now - t["created"] > self._retention_s:
+                self._drop(tid)
+        # cap total retained
+        if len(self._tasks) > self._max_retained:
+            for tid, _ in sorted(
+                ((tid, t) for tid, t in self._tasks.items() if t["future"].done()),
+                key=lambda x: x[1]["created"],
+            )[: len(self._tasks) - self._max_retained]:
+                self._drop(tid)
+
+    def _drop(self, tid: str) -> None:
+        t = self._tasks.pop(tid, None)
+        if t and t.get("session"):
+            self._by_session.pop((t["session"], t["endpoint"]), None)
+
+    def get_or_create_task(
+        self,
+        endpoint: str,
+        factory: Callable[[], OperationFuture],
+        user_task_id: Optional[str] = None,
+        session_key: Optional[str] = None,
+    ) -> Tuple[str, OperationFuture]:
+        """Return (task_id, future); reuses an existing task when the caller
+        provides its id or repeats the same session+endpoint."""
+        with self._lock:
+            self._gc()
+            if user_task_id:
+                t = self._tasks.get(user_task_id)
+                if t is None:
+                    raise KeyError(f"unknown User-Task-ID {user_task_id}")
+                return user_task_id, t["future"]
+            if session_key:
+                tid = self._by_session.get((session_key, endpoint))
+                # session reuse only attaches to an IN-FLIGHT request (its
+                # purpose is polling); a finished task must be fetched by
+                # explicit User-Task-ID, else a new request with different
+                # parameters would silently get stale results
+                if tid is not None and tid in self._tasks and not self._tasks[tid]["future"].done():
+                    return tid, self._tasks[tid]["future"]
+            active = sum(1 for t in self._tasks.values() if not t["future"].done())
+            if active >= self._max_active:
+                raise RuntimeError("too many active user tasks")
+            tid = self._uuid()
+            future = factory()
+            self._tasks[tid] = {
+                "future": future,
+                "endpoint": endpoint,
+                "created": self._clock(),
+                "session": session_key,
+            }
+            if session_key:
+                self._by_session[(session_key, endpoint)] = tid
+            return tid, future
+
+    def get(self, user_task_id: str) -> Optional[OperationFuture]:
+        with self._lock:
+            t = self._tasks.get(user_task_id)
+            return t["future"] if t else None
+
+    def describe_all(self) -> List[Dict]:
+        with self._lock:
+            self._gc()
+            return [
+                {
+                    "UserTaskId": tid,
+                    "RequestURL": t["endpoint"],
+                    "Status": "Completed" if t["future"].done() else "Active",
+                    "StartMs": int(t["created"] * 1000),
+                }
+                for tid, t in self._tasks.items()
+            ]
+
+    def mark_task_execution_began(self, user_task_id: str) -> None:
+        """Bridge to the executor (markTaskExecutionBegan :383): keeps the
+        task alive while its proposals execute."""
+        with self._lock:
+            t = self._tasks.get(user_task_id)
+            if t is not None:
+                t["created"] = self._clock()

@@ -168,6 +168,34 @@ class SimulatedCluster:
             self._assignment[partition, slots[0]] = dest_broker
             return True
 
+    def add_replica(self, partition: int, broker_index: int) -> bool:
+        """Grow the partition's replica set (RF increase), widening the
+        assignment matrix when every slot is taken."""
+        with self._lock:
+            row = self._assignment[partition]
+            if (row == broker_index).any():
+                return False
+            free = np.nonzero(row < 0)[0]
+            if free.size == 0:
+                pad = np.full((self._assignment.shape[0], 1), -1, dtype=np.int32)
+                self._assignment = np.concatenate([self._assignment, pad], axis=1)
+                self._assignment[partition, -1] = broker_index
+            else:
+                self._assignment[partition, free[0]] = broker_index
+            return True
+
+    def remove_replica(self, partition: int, broker_index: int) -> bool:
+        """Drop a non-leader replica (RF decrease), left-packing the row."""
+        with self._lock:
+            row = self._assignment[partition]
+            slots = np.nonzero(row == broker_index)[0]
+            if slots.size == 0 or slots[0] == 0:
+                return False
+            s = slots[0]
+            row[s:-1] = row[s + 1 :]
+            row[-1] = -1
+            return True
+
     def apply_leadership(self, partition: int, new_leader_broker: int) -> bool:
         """Preferred-leader election to an in-set replica."""
         with self._lock:

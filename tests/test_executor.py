@@ -1,0 +1,167 @@
+"""Executor subsystem tests.
+
+Mirrors cct/executor/ (ExecutionTaskPlannerTest, ExecutionTaskManagerTest,
+ExecutorTest against an embedded cluster — here the simulator plays the
+cluster, SURVEY.md §4 tier 5)."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor import (
+    ClusterDriver,
+    ExecutionTask,
+    ExecutionTaskManager,
+    ExecutionTaskPlanner,
+    Executor,
+    ExecutorConfig,
+    PostponeUrpReplicaMovementStrategy,
+    PrioritizeLargeReplicaMovementStrategy,
+    PrioritizeSmallReplicaMovementStrategy,
+    SimulatorClusterDriver,
+    TaskState,
+    TaskType,
+)
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster, unbalanced
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+
+def proposal(p, old, new, mb=0.0):
+    return ExecutionProposal(partition=p, old_replicas=old, new_replicas=new, data_to_move_mb=mb)
+
+
+def test_task_state_machine_valid_and_invalid():
+    t = ExecutionTask(0, proposal(0, (0, 1), (2, 1)), TaskType.INTER_BROKER_REPLICA_ACTION)
+    assert t.state == TaskState.PENDING
+    with pytest.raises(ValueError):
+        t.completed()  # PENDING -> COMPLETED is illegal
+    t.in_progress(5)
+    t.abort()
+    t.aborted(9)
+    assert t.done
+    with pytest.raises(ValueError):
+        t.in_progress()  # terminal
+
+
+def test_strategies_order_and_chain():
+    tasks = [
+        ExecutionTask(0, proposal(0, (0,), (1,), mb=10.0), TaskType.INTER_BROKER_REPLICA_ACTION),
+        ExecutionTask(1, proposal(1, (0,), (1,), mb=99.0), TaskType.INTER_BROKER_REPLICA_ACTION),
+        ExecutionTask(2, proposal(2, (0,), (1,), mb=50.0), TaskType.INTER_BROKER_REPLICA_ACTION),
+    ]
+    big_first = PrioritizeLargeReplicaMovementStrategy().apply(tasks)
+    assert [t.proposal.partition for t in big_first] == [1, 2, 0]
+    small_first = PrioritizeSmallReplicaMovementStrategy().apply(tasks)
+    assert [t.proposal.partition for t in small_first] == [0, 2, 1]
+    # URP first, ties broken by chained size-then-id
+    urp_then_big = PostponeUrpReplicaMovementStrategy().chain(
+        PrioritizeLargeReplicaMovementStrategy()
+    ).apply(tasks, urp={2})
+    assert [t.proposal.partition for t in urp_then_big] == [2, 1, 0]
+
+
+def test_planner_skips_noops_and_caps_concurrency():
+    planner = ExecutionTaskPlanner()
+    props = [
+        proposal(0, (0, 1), (2, 1)),  # move 0 -> 2
+        proposal(1, (0, 1), (0, 1)),  # no-op
+        proposal(2, (3, 4), (4, 3)),  # leadership only
+    ]
+    planner.add_execution_proposals(props)
+    assert len(planner.remaining_inter_broker_replica_movements) == 1
+    assert len(planner.remaining_leadership_movements) == 1
+
+    # concurrency: two moves share broker 9; one slot each -> only one drains
+    planner2 = ExecutionTaskPlanner()
+    planner2.add_execution_proposals(
+        [proposal(0, (9, 1), (5, 1)), proposal(1, (9, 2), (6, 2))]
+    )
+    slots = {9: 1, 1: 1, 2: 1, 5: 1, 6: 1}
+    batch = planner2.get_inter_broker_replica_movement_tasks(slots)
+    assert len(batch) == 1
+
+
+def test_manager_tracks_in_flight_and_slots():
+    mgr = ExecutionTaskManager(concurrent_partition_movements_per_broker=2)
+    t1 = ExecutionTask(0, proposal(0, (0,), (1,)), TaskType.INTER_BROKER_REPLICA_ACTION)
+    mgr.mark_in_progress([t1], now_ms=1)
+    assert mgr.available_slots([0, 1]) == {0: 1, 1: 1}
+    t1.completed(2)
+    mgr.mark_done(t1)
+    assert mgr.available_slots([0, 1]) == {0: 2, 1: 2}
+    assert mgr.tracker.summary()["numFinishedMovements"] == 1
+
+
+def test_executor_end_to_end_on_simulator():
+    sim = SimulatedCluster(unbalanced())
+    init = sim.model()
+    # move partition 0's replica off broker 0 to broker 2, and flip leadership of p2
+    props = [
+        proposal(0, (0, 1), (2, 1), mb=5.0),
+        proposal(2, (0, 2), (2, 0)),
+    ]
+    execu = Executor(SimulatorClusterDriver(sim, latency_polls=3))
+    result = execu.execute_proposals(props)
+    assert result["numFinishedMovements"] == 2
+    assert not result["stopped"]
+    final = sim.model()
+    assert sim.has_partition(0, 2) and not sim.has_partition(0, 0)
+    assert sim.leader_of(2) == 2
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_executor_pauses_sampling_and_records_history():
+    class FakeMonitor:
+        def __init__(self):
+            self.events = []
+
+        def pause_metric_sampling(self, reason=""):
+            self.events.append("pause")
+
+        def resume_metric_sampling(self):
+            self.events.append("resume")
+
+    sim = SimulatedCluster(unbalanced())
+    mon = FakeMonitor()
+    execu = Executor(SimulatorClusterDriver(sim), load_monitor=mon)
+    execu.execute_proposals(
+        [proposal(0, (0, 1), (2, 1))], removed_brokers={0}, demoted_brokers={1}
+    )
+    assert mon.events == ["pause", "resume"]
+    assert execu.recently_removed_brokers == {0}
+    assert execu.recently_demoted_brokers == {1}
+
+
+def test_executor_refuses_concurrent_and_ongoing():
+    sim = SimulatedCluster(unbalanced())
+    driver = SimulatorClusterDriver(sim, latency_polls=1)
+    # fake an external in-progress reassignment
+    driver.start_replica_movement(
+        ExecutionTask(99, proposal(1, (0, 2), (1, 2)), TaskType.INTER_BROKER_REPLICA_ACTION)
+    )
+    execu = Executor(driver)
+    with pytest.raises(RuntimeError, match="ongoing"):
+        execu.execute_proposals([proposal(0, (0, 1), (2, 1))])
+
+
+def test_full_loop_optimizer_to_executor_converges():
+    """Proposals from the analyzer, applied by the executor, produce the
+    optimizer's final placement on the simulated cluster."""
+    truth = random_cluster(
+        5, ClusterProperty(num_racks=3, num_brokers=6, num_topics=6, replication_factor=2)
+    )
+    sim = SimulatedCluster(truth)
+    settings = OptimizerSettings(batch_k=16, max_rounds_per_goal=8, num_dst_candidates=3)
+    result = GoalOptimizer(settings=settings).optimizations(
+        sim.model(), raise_on_hard_failure=False
+    )
+    execu = Executor(SimulatorClusterDriver(sim, latency_polls=2))
+    summary = execu.execute_proposals(result.proposals)
+    assert summary["numFinishedMovements"] == summary["numTotalMovements"]
+    final = np.asarray(sim.model().assignment)
+    want = np.asarray(result.final_assignment)
+    # replica sets and leaders must match (slot order may differ)
+    for p in range(final.shape[0]):
+        assert set(final[p][final[p] >= 0]) == set(want[p][want[p] >= 0]), p
+        assert final[p, 0] == want[p, 0], p

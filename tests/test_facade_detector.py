@@ -1,0 +1,224 @@
+"""Facade + async framework + detector/self-healing tests.
+
+Covers the reference's KafkaCruiseControl facade semantics (goal resolution,
+hard-goal check, proposal cache), the async OperationFuture flow, and the
+self-healing pipeline: kill a broker on the simulator -> detector ->
+notifier ladder -> decommission executes -> replicas evacuated
+(RandomSelfHealingTest / AnomalyDetectorTest analogs, SURVEY.md §4)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.async_ops import AsyncCruiseControl
+from cruise_control_tpu.detector import (
+    AnomalyDetector,
+    AnomalyNotificationResult,
+    BrokerFailureDetector,
+    BrokerFailures,
+    GoalViolationDetector,
+    MetricAnomaly,
+    PercentileMetricAnomalyFinder,
+    SelfHealingNotifier,
+    WebhookNotifier,
+)
+from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+from cruise_control_tpu.facade import CruiseControl, FacadeConfig, IllegalRequestException
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+from cruise_control_tpu.monitor.metadata import MetadataClient
+from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+from cruise_control_tpu.reporter.transport import InMemoryTransport
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+FAST = OptimizerSettings(batch_k=16, max_rounds_per_goal=8, num_dst_candidates=3)
+
+
+@pytest.fixture()
+def stack():
+    truth = random_cluster(
+        9, ClusterProperty(num_racks=3, num_brokers=6, num_topics=6, replication_factor=2)
+    )
+    sim = SimulatedCluster(truth)
+    transport = InMemoryTransport()
+    clock = {"now": 0.0}
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3, min_samples_per_window=1),
+        clock=lambda: clock["now"],
+    )
+    monitor.start_up()
+    for r in range(4):
+        transport.publish(sim.all_metrics(r * 1000 + 500))
+        clock["now"] = r + 0.8
+        monitor.sample_once()
+    executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor,
+        executor,
+        optimizer=GoalOptimizer(settings=FAST),
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    return sim, monitor, executor, facade, transport, clock
+
+
+def test_goal_resolution_and_hard_goal_check(stack):
+    _, _, _, facade, _, _ = stack
+    assert facade.goals_by_priority(None)[0] == "RackAwareGoal"
+    # order is priority order regardless of request order
+    got = facade.goals_by_priority(["ReplicaCapacityGoal", "RackAwareGoal"])
+    assert got == ["RackAwareGoal", "ReplicaCapacityGoal"]
+    with pytest.raises(IllegalRequestException, match="unknown"):
+        facade.goals_by_priority(["NoSuchGoal"])
+    with pytest.raises(IllegalRequestException, match="hard"):
+        facade.sanity_check_hard_goal_presence(["ReplicaDistributionGoal"])
+    facade.sanity_check_hard_goal_presence(["ReplicaDistributionGoal"], skip_hard_goal_check=True)
+
+
+def test_proposal_cache_hit_and_invalidation(stack):
+    sim, monitor, _, facade, transport, clock = stack
+    r1 = facade.get_proposals()
+    r2 = facade.get_proposals()
+    assert r2 is r1  # cache hit on same generation
+    # new samples bump the generation -> recompute
+    transport.publish(sim.all_metrics(5500))
+    clock["now"] = 5.8
+    monitor.sample_once()
+    r3 = facade.get_proposals()
+    assert r3 is not r1
+    # explicit goals always bypass the cache
+    r4 = facade.get_proposals(goal_names=["RackAwareGoal", "ReplicaCapacityGoal"])
+    assert r4 is not r3
+
+
+def test_rebalance_executes_on_cluster(stack):
+    sim, _, _, facade, _, _ = stack
+    before = np.asarray(sim.model().assignment).copy()
+    result = facade.rebalance(dryrun=False)
+    after = np.asarray(sim.model().assignment)
+    if result.proposals:  # the optimizer found improvements
+        assert not np.array_equal(before, after)
+    # replica sets converged to the optimizer's placement
+    want = result.final_assignment
+    for p in range(after.shape[0]):
+        assert set(after[p][after[p] >= 0]) == set(want[p][want[p] >= 0])
+
+
+def test_decommission_moves_replicas_off_broker(stack):
+    sim, _, _, facade, _, _ = stack
+    result = facade.decommission_brokers({2}, dryrun=False)
+    after = np.asarray(sim.model().assignment)
+    assert not (after == 2).any()
+    assert 2 in facade._executor.recently_removed_brokers
+
+
+def test_async_operations_and_precompute(stack):
+    _, _, _, facade, _, _ = stack
+    acc = AsyncCruiseControl(facade)
+    fut = acc.get_proposals()
+    res = fut.result(timeout=300)
+    assert fut.done() and res.goal_results
+    assert any("Running" in s["step"] for s in fut.progress.to_list())
+    # precompute warms the cache so a plain get_proposals is a hit
+    acc.start_proposal_precompute(interval_s=0.05)
+    time.sleep(0.4)
+    acc.shutdown()
+    assert facade._cached is not None
+
+
+def test_broker_failure_detector_persists(tmp_path, stack):
+    sim, monitor, _, _, _, clock = stack
+    path = str(tmp_path / "failed_brokers.json")
+    det = BrokerFailureDetector(monitor._metadata, persist_path=path, clock=lambda: clock["now"])
+    assert det.detect() is None
+    sim.kill_broker(1)
+    clock["now"] = 100.0
+    found = det.detect()
+    assert found is not None and 1 in found.failed_brokers
+    # failure time survives a detector restart (ZK-persisted list analog)
+    det2 = BrokerFailureDetector(monitor._metadata, persist_path=path, clock=lambda: clock["now"])
+    found2 = det2.detect()
+    assert found2.failed_brokers[1] == found.failed_brokers[1]
+    # recovery clears it
+    sim.restore_broker(1)
+    assert det2.detect() is None
+
+
+def test_self_healing_notifier_ladder():
+    alerts = []
+    notifier = SelfHealingNotifier(
+        broker_failure_alert_threshold_s=10.0,
+        self_healing_threshold_s=30.0,
+        alert_sink=alerts.append,
+    )
+    failure = BrokerFailures(failed_brokers={3: 0})
+    # before the alert threshold: delayed check, no alert
+    result, delay = notifier.on_anomaly(failure, now_ms=5_000)
+    assert result == AnomalyNotificationResult.CHECK and delay > 0 and not alerts
+    # past alert, before fix: check + alert fired
+    result, _ = notifier.on_anomaly(failure, now_ms=15_000)
+    assert result == AnomalyNotificationResult.CHECK and len(alerts) == 1
+    # past fix threshold: FIX
+    result, _ = notifier.on_anomaly(failure, now_ms=31_000)
+    assert result == AnomalyNotificationResult.FIX
+    # disabled self-healing: IGNORE even past threshold
+    off = SelfHealingNotifier(self_healing_broker_failure_enabled=False)
+    assert off.on_anomaly(failure, now_ms=10**10)[0] == AnomalyNotificationResult.IGNORE
+
+
+def test_webhook_notifier_posts_text():
+    posts = []
+    n = WebhookNotifier(posts.append, broker_failure_alert_threshold_s=0.0,
+                        self_healing_threshold_s=1e9)
+    n.on_anomaly(BrokerFailures(failed_brokers={0: 0}), now_ms=1000)
+    assert posts and "BROKER_FAILURE" in posts[0]
+
+
+def test_percentile_metric_anomaly_finder():
+    finder = PercentileMetricAnomalyFinder(min_history_windows=3)
+    b, w, m = 2, 5, 56
+    history = np.ones((b, w, m), dtype=np.float32)
+    current = np.ones((b, m), dtype=np.float32)
+    target = finder.interested_metrics[0]
+    current[1, target] = 100.0  # broker 1 spikes
+    found = finder.find(history, current)
+    assert len(found) == 1
+    assert found[0].broker_index == 1 and found[0].metric_name == target.name
+
+
+def test_self_healing_end_to_end(stack):
+    """Kill a broker; the detector + handler decommission it through the
+    facade and its replicas evacuate (GoalViolations/BrokerFailures fix path)."""
+    sim, monitor, executor, facade, transport, clock = stack
+    detector = AnomalyDetector(
+        facade,
+        notifier=SelfHealingNotifier(
+            broker_failure_alert_threshold_s=0.0, self_healing_threshold_s=0.0
+        ),
+        clock=lambda: clock["now"],
+    )
+    sim.kill_broker(0)
+    clock["now"] = 60.0
+    assert detector.detect_once() >= 1
+    action = detector.handle_once()
+    assert action == "FIX"
+    after = np.asarray(sim.model().assignment)
+    assert not (after == 0).any()
+    assert detector.state()["fixesTriggered"]["BROKER_FAILURE"] == 1
+
+
+def test_goal_violation_detector_finds_and_fixes(stack):
+    sim, monitor, executor, facade, transport, clock = stack
+    det = GoalViolationDetector(facade, detection_goals=["ReplicaDistributionGoal"])
+    found = det.detect()
+    if found is not None:
+        assert found.fixable_goals or found.unfixable_goals
+        # FIX path relaxes thresholds and executes
+        found.fix(facade)
+        assert facade._executor.state == "NO_TASK_IN_PROGRESS"

@@ -1,0 +1,259 @@
+"""REST API + CLI client tests.
+
+The servlet tier (KafkaCruiseControlServletEndpointTest / UserTaskManagerTest
+analogs): a real aiohttp server over the full simulated stack, driven by the
+actual CLI client, plus unit tests for the user task manager and purgatory."""
+
+import asyncio
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.async_ops import AsyncCruiseControl, OperationFuture
+from cruise_control_tpu.client.cccli import CruiseControlClient, main as cccli_main
+from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
+from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+from cruise_control_tpu.facade import CruiseControl, FacadeConfig
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+from cruise_control_tpu.monitor.metadata import MetadataClient
+from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+from cruise_control_tpu.reporter.transport import InMemoryTransport
+from cruise_control_tpu.servlet.purgatory import Purgatory, ReviewStatus
+from cruise_control_tpu.servlet.server import CruiseControlApp
+from cruise_control_tpu.servlet.user_tasks import UserTaskManager
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+FAST = OptimizerSettings(batch_k=16, max_rounds_per_goal=6, num_dst_candidates=3)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def server():
+    truth = random_cluster(
+        13, ClusterProperty(num_racks=3, num_brokers=6, num_topics=6, replication_factor=2)
+    )
+    sim = SimulatedCluster(truth)
+    transport = InMemoryTransport()
+    clock = {"now": 0.0}
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3, min_samples_per_window=1),
+        clock=lambda: clock["now"],
+    )
+    monitor.start_up()
+    for r in range(4):
+        transport.publish(sim.all_metrics(r * 1000 + 500))
+        clock["now"] = r + 0.8
+        monitor.sample_once()
+    executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor, optimizer=GoalOptimizer(settings=FAST),
+        config=FacadeConfig(default_requirements=ModelCompletenessRequirements(1, 0.5, False)),
+    )
+    acc = AsyncCruiseControl(facade)
+    detector = AnomalyDetector(facade, notifier=SelfHealingNotifier(), clock=lambda: clock["now"])
+    app = CruiseControlApp(acc, anomaly_detector=detector, response_wait_s=0.2)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    yield {"url": f"http://127.0.0.1:{port}", "sim": sim, "facade": facade}
+    loop.call_soon_threadsafe(loop.stop)
+    th.join(timeout=5)
+    acc.shutdown()
+
+
+def client_for(server) -> CruiseControlClient:
+    return CruiseControlClient(server["url"], poll_interval_s=0.1, timeout_s=600)
+
+
+def test_state_and_load_endpoints(server):
+    c = client_for(server)
+    state = c.request("state")
+    assert {"MonitorState", "ExecutorState", "AnalyzerState", "AnomalyDetectorState"} <= set(state)
+    load = c.request("load")
+    assert len(load["brokers"]) == 6
+    pl = c.request("partition_load", {"resource": "NW_OUT", "entries": 5})
+    assert len(pl["records"]) == 5
+    assert "topicPartition" in pl["records"][0]
+
+
+def test_kafka_cluster_state(server):
+    c = client_for(server)
+    out = c.request("kafka_cluster_state", {"verbose": "true"})
+    assert len(out["KafkaBrokerState"]) == 6
+    assert out["KafkaPartitionState"]
+
+
+def test_proposals_and_user_task_flow(server):
+    c = client_for(server)
+    out = c.request("proposals")  # polls 202 -> 200 via User-Task-ID
+    assert "goals" in out and "proposals" in out
+    tasks = c.request("user_tasks")["userTasks"]
+    assert any(t["RequestURL"] == "proposals" for t in tasks)
+
+
+def test_rebalance_dryrun_and_execute(server):
+    c = client_for(server)
+    before = np.asarray(server["sim"].model().assignment).copy()
+    dry = c.request("rebalance", {"dryrun": "true"})
+    assert np.array_equal(before, np.asarray(server["sim"].model().assignment))
+    assert "numReplicaMovements" in dry
+    out = c.request("rebalance", {"dryrun": "false", "ignore_proposal_cache": "true"})
+    assert "numReplicaMovements" in out
+
+
+def test_sampling_pause_resume_and_admin(server):
+    c = client_for(server)
+    assert "paused" in c.request("pause_sampling", {"reason": "test"})["message"]
+    assert server["facade"]._monitor.sampling_paused
+    c.request("resume_sampling")
+    assert not server["facade"]._monitor.sampling_paused
+    out = c.request("admin", {"concurrent_partition_movements_per_broker": "3"})
+    assert out.get("concurrencyUpdated")
+    out = c.request("admin", {"disable_self_healing_for": "goal_violation"})
+    assert out["selfHealing:goal_violation"] is False
+
+
+def test_topic_configuration_rf_change(server):
+    c = client_for(server)
+    out = c.request(
+        "topic_configuration",
+        {"topic": "topic-0", "replication_factor": "3", "dryrun": "false"},
+    )
+    assert out["replicationFactor"] == 3
+    sim = server["sim"]
+    topo = sim.fetch_topology()
+    t0 = [p for p in range(topo.num_partitions) if topo.topic_id[p] == 0]
+    for p in t0:
+        assert (np.asarray(topo.assignment)[p] >= 0).sum() == 3
+
+
+def test_train_and_bootstrap(server):
+    c = client_for(server)
+    out = c.request("train")
+    assert out["observations"] > 0
+    boot = c.request("bootstrap")
+    assert "bootstrappedSamples" in boot
+
+
+def test_cli_main_and_errors(server, capsys):
+    rc = cccli_main(["-a", server["url"], "state"])
+    assert rc == 0
+    assert "MonitorState" in capsys.readouterr().out
+    rc = cccli_main(["-a", server["url"], "proposals", "--goals", "NoSuchGoal"])
+    assert rc == 1
+
+
+def test_user_task_manager_semantics():
+    now = {"t": 0.0}
+    ids = iter(f"id-{i}" for i in range(100))
+    mgr = UserTaskManager(
+        max_active_tasks=2, completed_retention_s=10.0, clock=lambda: now["t"],
+        uuid_factory=lambda: next(ids),
+    )
+
+    def make():
+        return OperationFuture("op")
+
+    t1, f1 = mgr.get_or_create_task("proposals", make, session_key="s1")
+    # same session+endpoint reattaches
+    t2, f2 = mgr.get_or_create_task("proposals", make, session_key="s1")
+    assert t1 == t2 and f1 is f2
+    # explicit id reattaches
+    t3, f3 = mgr.get_or_create_task("proposals", make, user_task_id=t1)
+    assert f3 is f1
+    with pytest.raises(KeyError):
+        mgr.get_or_create_task("proposals", make, user_task_id="nope")
+    # active cap
+    mgr.get_or_create_task("rebalance", make, session_key="s2")
+    with pytest.raises(RuntimeError, match="active"):
+        mgr.get_or_create_task("load", make, session_key="s3")
+    # completion + retention GC
+    f1.set_result(1)
+    now["t"] = 100.0
+    mgr.get_or_create_task("load", make, session_key="s3")
+    assert all(t["UserTaskId"] != t1 for t in mgr.describe_all())
+
+
+def test_purgatory_two_step_flow():
+    purgatory = Purgatory()
+    rid = purgatory.add_request("rebalance", {"dryrun": "false"})
+    board = purgatory.review_board()["RequestInfo"]
+    assert board[0]["Status"] == "PENDING_REVIEW"
+    with pytest.raises(ValueError, match="not APPROVED"):
+        purgatory.submit(rid)
+    purgatory.apply_review([rid], [])
+    info = purgatory.submit(rid)
+    assert info["status"] == ReviewStatus.SUBMITTED
+    with pytest.raises(ValueError):
+        purgatory.submit(rid)  # exactly once
+    rid2 = purgatory.add_request("admin", {})
+    purgatory.apply_review([], [rid2], reason="nope")
+    assert purgatory.review_board()["RequestInfo"][-1]["Status"] == "DISCARDED"
+
+
+def test_two_step_verification_gate(server):
+    """A reviewable POST parks in purgatory until approved."""
+    truth = random_cluster(3, ClusterProperty(num_racks=2, num_brokers=4, num_topics=3))
+    sim = SimulatedCluster(truth)
+    # minimal stack with 2-step on: reuse the module server's facade pieces
+    facade = server["facade"]
+    acc = AsyncCruiseControl(facade)
+    app = CruiseControlApp(acc, two_step_verification=True, response_wait_s=0.2)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        loop.run_until_complete(web.TCPSite(runner, "127.0.0.1", port).start())
+        started.set()
+        loop.run_forever()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    try:
+        c = CruiseControlClient(f"http://127.0.0.1:{port}", poll_interval_s=0.1)
+        parked = c.request("rebalance", {"dryrun": "true"})
+        assert parked["status"] == "PENDING_REVIEW"
+        rid = parked["reviewId"]
+        c.request("review", {"approve": str(rid)})
+        out = c.request("rebalance", {"dryrun": "true", "review_id": str(rid)})
+        assert "numReplicaMovements" in out
+        # a second submit with the same review id is rejected
+        again = c.request("rebalance", {"dryrun": "true", "review_id": str(rid)})
+        assert "errorMessage" in again
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=5)
+        acc.shutdown()

@@ -1,0 +1,126 @@
+"""Swap search + kafka-assigner mode tests.
+
+The swap fixture engineers the reference's classic deadlock
+(ResourceDistributionGoal.rebalanceBySwapping*): a hot broker whose every
+replica is too big to MOVE anywhere (any move overshoots the destination's
+window), but where EXCHANGING a big replica for a small one balances the
+pair."""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goals import (
+    GOAL_REGISTRY,
+    KAFKA_ASSIGNER_GOALS,
+    goals_by_priority,
+    is_kafka_assigner_mode,
+)
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.common.resources import BrokerState, PartMetric
+from cruise_control_tpu.models.flat_model import broker_loads, sanity_check
+from cruise_control_tpu.models.generators import (
+    ClusterProperty,
+    make_model,
+    random_cluster,
+    _part_load,
+    _uniform_capacity,
+)
+
+SWAP_SETTINGS = OptimizerSettings(
+    batch_k=8, max_rounds_per_goal=16, num_dst_candidates=4,
+    num_swap_pairs=4, swap_candidates=4,
+)
+
+
+def swap_deadlock_model():
+    """2 brokers, RF1: broker 0 holds two 40-unit disk partitions, broker 1
+    two 10-unit ones (capacity 100). Any single move lands a broker at
+    90/10 or 50/50... moving a 40 to broker 1 gives 40/60 -> acceptance
+    fails the window on one side; swapping 40 <-> 10 yields 70/30 -> 50/50
+    territory. Constructed so moves strictly worsen the window while one
+    swap balances."""
+    assignment = np.array([[0], [0], [1], [1]], dtype=np.int32)
+    topic_id = np.array([0, 1, 2, 3], dtype=np.int32)
+    load = _part_load(
+        cpu_leader=[1.0, 1.0, 1.0, 1.0],
+        nw_in_leader=[10.0, 10.0, 10.0, 10.0],
+        nw_out_leader=[10.0, 10.0, 10.0, 10.0],
+        disk=[40.0e4, 40.0e4, 10.0e4, 10.0e4],
+    )
+    cap = _uniform_capacity(2, disk=1.0e6)
+    rack = np.array([0, 1], dtype=np.int32)
+    return make_model(assignment, load, topic_id, cap, rack)
+
+
+def test_swap_balances_where_moves_cannot():
+    m = swap_deadlock_model()
+    before = np.asarray(broker_loads(m))[:, 3]  # disk per broker: 80/20
+    assert before[0] == pytest.approx(80.0e4)
+    res = GoalOptimizer(settings=SWAP_SETTINGS).optimizations(
+        m, goal_names=["DiskUsageDistributionGoal"], raise_on_hard_failure=False
+    )
+    final = m._replace(assignment=res.final_assignment)
+    sanity_check(final)
+    after = np.asarray(broker_loads(final))[:, 3]
+    # balanced at 50/50 — only a swap reaches this (a single move gives
+    # 40/60 at best and the windowed acceptance blocks overshoot)
+    assert after[0] == pytest.approx(50.0e4)
+    assert after[1] == pytest.approx(50.0e4)
+    assert res.goal_results[0].cost_after == pytest.approx(0.0, abs=1e-5)
+
+
+def test_swap_respects_rack_awareness():
+    """Swaps must never break rack placement of either partition."""
+    prop = ClusterProperty(
+        num_racks=3, num_brokers=6, num_topics=8, replication_factor=3,
+        load_distribution="exponential", mean_utilization=0.5,
+    )
+    m = random_cluster(17, prop)
+    res = GoalOptimizer(settings=SWAP_SETTINGS).optimizations(
+        m,
+        goal_names=["RackAwareGoal", "DiskUsageDistributionGoal"],
+        raise_on_hard_failure=False,
+    )
+    final = m._replace(assignment=res.final_assignment)
+    sanity_check(final)
+    rack = np.asarray(m.broker_rack)
+    a = res.final_assignment
+    for p in range(a.shape[0]):
+        racks = [rack[b] for b in a[p] if b >= 0]
+        assert len(racks) == len(set(racks)), f"partition {p} rack collision"
+
+
+def test_kafka_assigner_mode_detection_and_resolution():
+    assert is_kafka_assigner_mode(["KafkaAssignerEvenRackAwareGoal"])
+    assert not is_kafka_assigner_mode(["RackAwareGoal"])
+    assert not is_kafka_assigner_mode(None)
+    goals = goals_by_priority(
+        ["KafkaAssignerDiskUsageDistributionGoal", "KafkaAssignerEvenRackAwareGoal"]
+    )
+    # rack-aware goal always first in assigner mode
+    assert [g.name for g in goals] == [
+        "KafkaAssignerEvenRackAwareGoal",
+        "KafkaAssignerDiskUsageDistributionGoal",
+    ]
+    for g in KAFKA_ASSIGNER_GOALS:
+        assert g.name in GOAL_REGISTRY
+
+
+def test_kafka_assigner_even_distribution():
+    """Even-rack-aware goal levels replica counts to within one of the mean."""
+    prop = ClusterProperty(
+        num_racks=3, num_brokers=6, num_topics=6, replication_factor=2,
+        rack_aware_placement=False,
+    )
+    m = random_cluster(23, prop)
+    res = GoalOptimizer(settings=SWAP_SETTINGS).optimizations(
+        m, goal_names=["KafkaAssignerEvenRackAwareGoal"], raise_on_hard_failure=False
+    )
+    final = m._replace(assignment=res.final_assignment)
+    sanity_check(final)
+    counts = np.bincount(
+        res.final_assignment[res.final_assignment >= 0], minlength=6
+    )
+    avg = counts.mean()
+    assert counts.max() <= np.ceil(avg) + 1
+    assert counts.min() >= np.floor(avg) - 1

@@ -4,63 +4,142 @@ BASELINE config 5 — 2,600 brokers / ~200k partitions / RF 3 — through the
 complete default hard+soft goal stack. North star (BASELINE.md): < 10 s
 wall-clock on a v5e-8 with goal-violation scores <= the stock greedy.
 
-Prints ONE JSON line:
+Output contract: stdout carries ONLY JSON lines of the form
   {"metric": "...", "value": N, "unit": "...", "vs_baseline": N}
+one per completed stage (configs run smallest-first, so a timeout still
+leaves the largest *completed* config as the last line — parse the last
+line). All diagnostics go to stderr, flushed, starting with backend/device
+info so a hang is attributable.
+
 `value` is the steady-state proposal-generation wall-clock (the production
 regime: the proposal precompute loop reuses compiled kernels across model
 generations, cc/analyzer/GoalOptimizer.java:129-179, so a warm-up pass
 compiles and the timed pass measures). `vs_baseline` = 10 s target / value
 (> 1 means faster than target).
 
-Env overrides: BENCH_CONFIG (1-5, default 5), BENCH_SEED.
+Platform handling: the default backend (TPU) is probed in a subprocess with
+a timeout first; if its init hangs (dead axon tunnel — the round-1 failure
+mode), the run degrades to a labeled CPU number instead of dying silently.
+
+Usage: python bench.py [--smoke]        # --smoke = config 1 only, fast
+Env overrides: BENCH_CONFIG (single config 1-5), BENCH_SEED,
+BENCH_PROBE_TIMEOUT_S, BENCH_STAGES (comma list, default "1,2,5").
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import sys
 import time
+import traceback
 
 
-def main() -> None:
-    cfg_id = int(os.environ.get("BENCH_CONFIG", "5"))
-    seed = int(os.environ.get("BENCH_SEED", "42"))
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
 
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload), flush=True)
+
+
+TARGET_S = 10.0
+
+
+def run_config(cfg_id: int, seed: int, platform: str) -> float:
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
     from cruise_control_tpu.models.generators import BASELINE_CONFIGS, random_cluster
 
+    t_build = time.monotonic()
     model = random_cluster(seed, BASELINE_CONFIGS[cfg_id])
+    log(
+        f"[config {cfg_id}] model: {model.num_brokers} brokers / "
+        f"{model.num_partitions} partitions / rf {model.assignment.shape[1]} "
+        f"(built in {time.monotonic() - t_build:.1f}s)"
+    )
     settings = OptimizerSettings(batch_k=256, max_rounds_per_goal=24, num_dst_candidates=16)
     optimizer = GoalOptimizer(settings=settings)
 
-    # Warm-up pass: compiles every per-goal step for these dims (cached).
-    optimizer.optimizations(model, raise_on_hard_failure=False)
+    def prog(tag):
+        def cb(goal_name, seconds):
+            log(f"[config {cfg_id}] {tag} {goal_name}: {seconds:.2f}s")
+        return cb
 
     t0 = time.monotonic()
-    result = optimizer.optimizations(model, raise_on_hard_failure=False)
-    wall = time.monotonic() - t0
+    optimizer.optimizations(model, raise_on_hard_failure=False, progress=prog("warmup"))
+    log(f"[config {cfg_id}] warmup (compile) pass: {time.monotonic() - t0:.1f}s")
 
-    target_s = 10.0
-    print(
-        json.dumps(
+    t0 = time.monotonic()
+    result = optimizer.optimizations(
+        model, raise_on_hard_failure=False, progress=prog("timed")
+    )
+    wall = time.monotonic() - t0
+    log(
+        f"[config {cfg_id}] timed pass: {wall:.3f}s moves={result.num_replica_moves} "
+        f"leadership={result.num_leadership_moves} "
+        f"violated_before={result.violated_goals_before} "
+        f"violated_after={result.violated_goals_after}"
+    )
+    emit(
+        {
+            "metric": (
+                f"full-goal proposal generation, BASELINE config {cfg_id} "
+                f"({model.num_brokers} brokers / {model.num_partitions} partitions, "
+                f"{platform})"
+            ),
+            "value": round(wall, 3),
+            "unit": "s",
+            "vs_baseline": round(TARGET_S / wall, 3),
+        }
+    )
+    return wall
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true", help="config 1 only (<60s)")
+    args = parser.parse_args()
+
+    log(f"bench.py starting: python {sys.version.split()[0]}, pid {os.getpid()}")
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
+
+    from cruise_control_tpu.platform_probe import ensure_live_backend
+
+    ensure_live_backend(timeout_s=probe_timeout, log=log)
+
+    import jax
+
+    platform = jax.default_backend()
+    log(f"backend: {platform}, devices: {jax.devices()}")
+
+    seed = int(os.environ.get("BENCH_SEED", "42"))
+    if args.smoke:
+        stages = [1]
+    elif "BENCH_CONFIG" in os.environ:
+        stages = [int(os.environ["BENCH_CONFIG"])]
+    else:
+        stages = [int(s) for s in os.environ.get("BENCH_STAGES", "1,2,5").split(",")]
+
+    completed = 0
+    for cfg_id in stages:
+        try:
+            run_config(cfg_id, seed, platform)
+            completed += 1
+        except Exception:
+            log(f"[config {cfg_id}] FAILED:\n{traceback.format_exc()}")
+            break
+    if completed == 0:
+        # still emit a parsable line so the driver records the failure mode
+        emit(
             {
-                "metric": f"full-goal proposal generation, BASELINE config {cfg_id} "
-                f"({model.num_brokers} brokers / {model.num_partitions} partitions)",
-                "value": round(wall, 3),
+                "metric": f"bench failed before any config completed ({platform})",
+                "value": -1.0,
                 "unit": "s",
-                "vs_baseline": round(target_s / wall, 3),
+                "vs_baseline": 0.0,
             }
         )
-    )
-    # secondary detail on stderr for humans; the driver reads stdout line 1
-    import sys
-
-    print(
-        f"moves={result.num_replica_moves} leadership={result.num_leadership_moves} "
-        f"violated_before={result.violated_goals_before} "
-        f"violated_after={result.violated_goals_after}",
-        file=sys.stderr,
-    )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -375,7 +375,11 @@ class GoalOptimizer:
         goal_names: Optional[Sequence[str]] = None,
         options: OptimizationOptions = OptimizationOptions(),
         raise_on_hard_failure: bool = True,
+        progress=None,
     ) -> OptimizerResult:
+        """`progress`: optional callable(goal_name, seconds) invoked after each
+        goal finishes — the analog of the reference's OperationProgress steps
+        (cc/async/progress/OptimizationForGoal)."""
         t0 = time.monotonic()
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
@@ -428,6 +432,8 @@ class GoalOptimizer:
                     duration_s=time.monotonic() - g0,
                 )
             )
+            if progress is not None:
+                progress(goal.name, time.monotonic() - g0)
             if goal.is_hard and viol_after > 0 and raise_on_hard_failure:
                 raise OptimizationFailureException(
                     f"hard goal {goal.name} still violated on {viol_after} broker(s)"

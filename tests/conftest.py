@@ -3,16 +3,10 @@
 Multi-chip TPU hardware is not available in CI; sharding/pjit paths are
 validated on 8 virtual CPU devices instead (same XLA partitioner). The axon
 site customization pins jax_platforms programmatically, so the env var alone
-is not enough — jax.config must be updated before any backend initializes.
+is not enough — jax.config must be updated before any backend initializes
+(cruise_control_tpu.platform_probe.pin_cpu does exactly that).
 """
 
-import os
+from cruise_control_tpu.platform_probe import pin_cpu
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
+pin_cpu(device_count=8)

@@ -1,0 +1,171 @@
+"""Shared acceptance tables: the whole prior-goal chain as ONE kernel.
+
+The reference re-checks every previously-optimized goal's `actionAcceptance`
+per candidate action (AbstractGoal.maybeApplyBalancingAction,
+cc/analyzer/goals/AbstractGoal.java:186-227 via AnalyzerUtils
+.isProposalAcceptableForOptimizedGoals). Round 1 translated that as a Python
+loop over prior goals inside every jitted goal step — correct, but each
+goal's XLA program inlined every prior's kernel over the full candidate
+grid, growing the compiled program O(goals^2) across the stack.
+
+The TPU-native fix exploits that every goal's acceptance predicate is a
+box constraint on the post-action value of a small set of per-broker (or
+per-topic / per-host) aggregates:
+
+  RackAwareGoal                 dst rack must not already host the partition
+  ReplicaCapacityGoal           replica_count[dst]' <= max
+  CapacityGoal(res)             broker_load[dst, res]' <= cap limit (+ host CPU)
+  ReplicaDistributionGoal       count' within [lo, hi] (src lo waived if dead)
+  LeaderReplicaDistributionGoal leader_count' within [lo, hi]
+  ResourceDistributionGoal(res) util' within [lo, hi]  (== raw load within
+                                [lo*cap_b, hi*cap_b] per broker)
+  TopicReplicaDistributionGoal  topic_replica_count[t, ·]' within [lo_t, hi_t]
+  PotentialNwOutGoal            potential_nw_out[dst]' <= cap limit
+  LeaderBytesInDistributionGoal leader_nw_in[dst]' <= hi (waived if src dead)
+
+So each optimized goal *contributes* its bounds into an `AcceptanceTables`
+(elementwise min of uppers / max of lowers), and a single fixed-size kernel
+`tables_acceptance` checks any candidate batch against the merged tables.
+Per-goal program size no longer depends on how many goals ran before it.
+
+Uniform conventions (matching the per-goal kernels they replace):
+- every upper-bound check is exempt when the action does not increase the
+  tracked quantity at dst (delta <= 0);
+- every lower-bound check applies at src and is waived when src is dead
+  (self-healing: load must leave dead brokers no matter what);
+- `hi_lnw_waive_dead` reproduces LeaderBytesInDistributionGoal's dst-side
+  dead-source waiver.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import ActionBatch
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx
+from cruise_control_tpu.common.resources import Resource
+
+_INF = jnp.float32(jnp.inf)
+
+
+class AcceptanceTables(NamedTuple):
+    """Merged box constraints of all previously-optimized goals.
+
+    All bounds are in raw aggregate units (loads, counts); +/-inf disables.
+    """
+
+    hi_load: jax.Array  # f32[B, 4]
+    lo_load: jax.Array  # f32[B, 4]
+    hi_rep: jax.Array  # f32[B]
+    lo_rep: jax.Array  # f32[B]
+    hi_lead: jax.Array  # f32[B]
+    lo_lead: jax.Array  # f32[B]
+    hi_pnw: jax.Array  # f32[B]
+    hi_lnw: jax.Array  # f32[B]
+    hi_lnw_waive_dead: jax.Array  # bool[]
+    hi_topic: jax.Array  # f32[T]
+    lo_topic: jax.Array  # f32[T]
+    hi_host_cpu: jax.Array  # f32[H]
+    rack_enabled: jax.Array  # bool[]
+
+
+def empty_tables(dims) -> AcceptanceTables:
+    b, t, h = dims.num_brokers, dims.num_topics, dims.num_hosts
+    return AcceptanceTables(
+        hi_load=jnp.full((b, 4), _INF),
+        lo_load=jnp.full((b, 4), -_INF),
+        hi_rep=jnp.full((b,), _INF),
+        lo_rep=jnp.full((b,), -_INF),
+        hi_lead=jnp.full((b,), _INF),
+        lo_lead=jnp.full((b,), -_INF),
+        hi_pnw=jnp.full((b,), _INF),
+        hi_lnw=jnp.full((b,), _INF),
+        hi_lnw_waive_dead=jnp.asarray(False),
+        hi_topic=jnp.full((t,), _INF),
+        lo_topic=jnp.full((t,), -_INF),
+        hi_host_cpu=jnp.full((h,), _INF),
+        rack_enabled=jnp.asarray(False),
+    )
+
+
+def build_tables(
+    priors: Sequence, static: StaticCtx, agg: Aggregates, dims
+) -> AcceptanceTables:
+    """Merge every prior goal's bounds (thresholds from round-start `agg`,
+    exactly when the per-goal `prepare`/initGoalState ran before)."""
+    tables = empty_tables(dims)
+    for g in priors:
+        gs = g.prepare(static, agg, dims)
+        tables = g.contribute_acceptance(static, gs, tables)
+    return tables
+
+
+def tables_acceptance(
+    static: StaticCtx, tables: AcceptanceTables, agg: Aggregates, act: ActionBatch
+) -> jax.Array:
+    """bool[...]: does the action satisfy EVERY merged bound?
+
+    Values are read from the *current* aggregates (they may be mid-apply-scan);
+    the bounds were fixed at round start — the same split the per-goal chain
+    had (thresholds from initGoalState, values from the live model).
+    """
+    src, dst = act.src, act.dst
+    dead_src = static.dead[src]
+
+    # per-resource broker load
+    d = act.dload  # [..., 4]
+    load_dst_after = agg.broker_load[dst] + d
+    load_src_after = agg.broker_load[src] - d
+    inc = d > 0.0
+    ok = jnp.all(~inc | (load_dst_after <= tables.hi_load[dst]), axis=-1)
+    ok &= dead_src | jnp.all(
+        ~inc | (load_src_after >= tables.lo_load[src]), axis=-1
+    )
+
+    # replica count
+    drep = act.drep.astype(jnp.float32)
+    rep_inc = drep > 0
+    ok &= ~rep_inc | (agg.replica_count[dst] + drep <= tables.hi_rep[dst])
+    ok &= ~rep_inc | dead_src | (agg.replica_count[src] - drep >= tables.lo_rep[src])
+
+    # leader count
+    dlead = act.dleader.astype(jnp.float32)
+    lead_inc = dlead > 0
+    ok &= ~lead_inc | (agg.leader_count[dst] + dlead <= tables.hi_lead[dst])
+    ok &= ~lead_inc | dead_src | (agg.leader_count[src] - dlead >= tables.lo_lead[src])
+
+    # potential NW_OUT
+    pnw_inc = act.dpnw > 0.0
+    ok &= ~pnw_inc | (agg.potential_nw_out[dst] + act.dpnw <= tables.hi_pnw[dst])
+
+    # leader bytes-in (dead-source waiver flag per LeaderBytesInDistributionGoal)
+    lnw_inc = act.dleader_nw_in > 0.0
+    lnw_ok = agg.leader_nw_in[dst] + act.dleader_nw_in <= tables.hi_lnw[dst]
+    ok &= ~lnw_inc | lnw_ok | (tables.hi_lnw_waive_dead & dead_src)
+
+    # per-topic replica count (replica moves only: drep carries the indicator)
+    topic = static.topic_id[act.p]
+    ok &= ~rep_inc | (
+        agg.topic_replica_count[topic, dst] + act.drep <= tables.hi_topic[topic]
+    )
+    ok &= ~rep_inc | dead_src | (
+        agg.topic_replica_count[topic, src] - act.drep >= tables.lo_topic[topic]
+    )
+
+    # host-level CPU (CpuCapacityGoal); same-host moves shift nothing
+    dcpu = d[..., Resource.CPU]
+    host_src = static.broker_host[src]
+    host_dst = static.broker_host[dst]
+    host_after = agg.host_cpu_load[host_dst] + jnp.where(host_src == host_dst, 0.0, dcpu)
+    ok &= (dcpu <= 0.0) | (host_after <= tables.hi_host_cpu[host_dst])
+
+    # rack safety (replica moves only): dst rack must not keep a sibling
+    rack_src = static.broker_rack[src]
+    rack_dst = static.broker_rack[dst]
+    count_dst = agg.rack_replica_count[act.p, rack_dst] - (rack_src == rack_dst)
+    ok &= ~(tables.rack_enabled & rep_inc) | (count_dst == 0)
+
+    return ok

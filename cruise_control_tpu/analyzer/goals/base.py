@@ -63,6 +63,15 @@ class Goal:
         """bool[...]: would this goal still hold (not get worse) after act?"""
         raise NotImplementedError
 
+    def contribute_acceptance(self, static: StaticCtx, gs, tables):
+        """Merge this goal's acceptance bounds into shared AcceptanceTables.
+
+        Once a goal is optimized, later goals enforce it through the merged
+        tables (analyzer.acceptance) instead of re-inlining this goal's
+        `acceptance` kernel per candidate — the O(goals^2)-breaker. Must
+        encode exactly the same box constraints `acceptance` checks."""
+        raise NotImplementedError
+
     def action_score(self, static: StaticCtx, gs, agg: Aggregates, act: ActionBatch) -> jax.Array:
         """f32[...]: improvement of this goal from act; <= 0 when no help."""
         raise NotImplementedError

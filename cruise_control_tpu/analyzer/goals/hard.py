@@ -68,6 +68,9 @@ class RackAwareGoal(Goal):
         tiebreak = 1e-3 * (1.0 - jnp.tanh(jnp.max(util, axis=1)))[act.dst]
         return jnp.where(is_move & dup, 1.0 + tiebreak, 0.0)
 
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(rack_enabled=jnp.asarray(True))
+
 
 class ReplicaCapacityGoal(Goal):
     """Replica count per broker <= max.replicas.per.broker
@@ -99,6 +102,10 @@ class ReplicaCapacityGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
+
+    def contribute_acceptance(self, static, gs, tables):
+        cap = static.max_replicas_per_broker.astype(jnp.float32)
+        return tables._replace(hi_rep=jnp.minimum(tables.hi_rep, cap))
 
 
 class CapacityGoalState(NamedTuple):
@@ -164,3 +171,14 @@ class CapacityGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return gs.limit - agg.broker_load[:, self.resource]
+
+    def contribute_acceptance(self, static, gs, tables):
+        hi = tables.hi_load.at[:, self.resource].min(gs.limit)
+        tables = tables._replace(hi_load=hi)
+        if self.resource == Resource.CPU:
+            tables = tables._replace(
+                hi_host_cpu=jnp.minimum(
+                    tables.hi_host_cpu, static.host_cpu_capacity_limit
+                )
+            )
+        return tables

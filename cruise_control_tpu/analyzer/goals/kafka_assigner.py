@@ -75,6 +75,11 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
 
+    def contribute_acceptance(self, static, gs, tables):
+        tables = self._rack.contribute_acceptance(static, None, tables)
+        # strict evenness caps dst only (no src lower bound in acceptance)
+        return tables._replace(hi_rep=jnp.minimum(tables.hi_rep, gs.upper))
+
 
 class KafkaAssignerDiskUsageDistributionGoal(ResourceDistributionGoal):
     """Disk balance in kafka-assigner mode; same kernel as

@@ -113,6 +113,16 @@ class ResourceDistributionGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -self._util(static, agg)
 
+    def contribute_acceptance(self, static, gs, tables):
+        # bounds are on utilization; in raw-load units they are per-broker
+        cap = static.broker_capacity[:, self.resource]
+        hi = jnp.where(gs.active, gs.upper * cap, jnp.inf)
+        lo = jnp.where(gs.active, gs.lower * cap, -jnp.inf)
+        return tables._replace(
+            hi_load=tables.hi_load.at[:, self.resource].min(hi),
+            lo_load=tables.lo_load.at[:, self.resource].max(lo),
+        )
+
 
 class ReplicaDistributionGoal(Goal):
     """Replica count per broker within the balance window around the mean
@@ -156,6 +166,12 @@ class ReplicaDistributionGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
 
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(
+            hi_rep=jnp.minimum(tables.hi_rep, gs.upper),
+            lo_rep=jnp.maximum(tables.lo_rep, gs.lower),
+        )
+
 
 class LeaderReplicaDistributionGoal(Goal):
     """Leader count per broker within the balance window
@@ -198,6 +214,12 @@ class LeaderReplicaDistributionGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return -agg.leader_count.astype(jnp.float32)
+
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(
+            hi_lead=jnp.minimum(tables.hi_lead, gs.upper),
+            lo_lead=jnp.maximum(tables.lo_lead, gs.lower),
+        )
 
 
 class TopicWindowState(NamedTuple):
@@ -247,6 +269,12 @@ class TopicReplicaDistributionGoal(Goal):
         )
         return jnp.where(is_move, score, 0.0)
 
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(
+            hi_topic=jnp.minimum(tables.hi_topic, gs.upper),
+            lo_topic=jnp.maximum(tables.lo_topic, gs.lower),
+        )
+
 
 class PotentialNwOutGoal(Goal):
     """Even if every replica on a broker became leader, its NW_OUT stays under
@@ -281,6 +309,9 @@ class PotentialNwOutGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return self._limit(static) - agg.potential_nw_out
+
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(hi_pnw=jnp.minimum(tables.hi_pnw, self._limit(static)))
 
 
 class LeaderBytesInDistributionGoal(Goal):
@@ -323,3 +354,9 @@ class LeaderBytesInDistributionGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return -agg.leader_nw_in
+
+    def contribute_acceptance(self, static, gs, tables):
+        return tables._replace(
+            hi_lnw=jnp.minimum(tables.hi_lnw, gs.upper),
+            hi_lnw_waive_dead=jnp.asarray(True),
+        )

@@ -26,8 +26,10 @@ full optimization run is a handful of XLA executions.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import functools
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -55,6 +57,7 @@ from cruise_control_tpu.analyzer.context import (
     dims_of,
     dst_hosts_partition,
 )
+from cruise_control_tpu.analyzer.acceptance import build_tables, tables_acceptance
 from cruise_control_tpu.analyzer.goals import goals_by_priority
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
@@ -85,6 +88,12 @@ class OptimizerSettings:
     #: hot/cold broker pairs per round x candidate replicas per broker
     num_swap_pairs: int = 8
     swap_candidates: int = 8
+    #: pad the partition and topic axes to coarse buckets so count churn
+    #: (partition/topic create/delete) reuses compiled goal steps instead of
+    #: recompiling; broker churn still recompiles (rare in practice)
+    bucket_partitions: bool = True
+    #: AOT-compile all goal steps concurrently before the first goal runs
+    parallel_compile: bool = True
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -119,13 +128,15 @@ def _score_batch(
     act: ActionBatch,
     goal: Goal,
     gs,
-    priors: Sequence[Goal],
-    prior_states: Sequence,
+    tables,
 ):
-    """f32[...]: masked score of each candidate (-inf where unacceptable)."""
+    """f32[...]: masked score of each candidate (-inf where unacceptable).
+
+    All prior goals' acceptance is enforced by the merged `tables` in one
+    fixed-size kernel (analyzer.acceptance) — the program no longer grows
+    with the number of previously-optimized goals."""
     mask = _structural_mask(static, agg, act)
-    for g, pgs in zip(priors, prior_states):
-        mask = mask & g.acceptance(static, pgs, agg, act)
+    mask = mask & tables_acceptance(static, tables, agg, act)
     mask = mask & goal.acceptance(static, gs, agg, act)
     score = goal.action_score(static, gs, agg, act)
     # Evacuating dead brokers dominates any balance improvement: every goal
@@ -162,9 +173,8 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
     k_sel = max(1, min(settings.batch_k, p_count))
     use_leadership = goal.uses_leadership and r >= 2
 
-    def one_round(static: StaticCtx, agg: Aggregates):
+    def one_round(static: StaticCtx, agg: Aggregates, tables):
         gs = goal.prepare(static, agg, dims)
-        prior_states = [g.prepare(static, agg, dims) for g in priors]
 
         # ---- move family: [P, R, K] grid
         dst_cands = _dst_candidates(static, gs, agg, goal, dims, k_dst)
@@ -176,7 +186,7 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
 
         if goal.uses_moves:
             mv = make_move_batch(static.part_load, agg.assignment, dst_cands)
-            s = _score_batch(static, agg, mv, goal, gs, priors, prior_states)
+            s = _score_batch(static, agg, mv, goal, gs, tables)
             s = jnp.broadcast_to(s, (p_count, r, kk)).reshape(p_count, r * kk)
             j = jnp.argmax(s, axis=1)
             sm = jnp.take_along_axis(s, j[:, None], axis=1)[:, 0]
@@ -188,7 +198,7 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         # ---- leadership family: [P, R-1] grid
         if use_leadership:
             lb = make_leadership_batch(static.part_load, agg.assignment)
-            sl = _score_batch(static, agg, lb, goal, gs, priors, prior_states)
+            sl = _score_batch(static, agg, lb, goal, gs, tables)
             sl = jnp.broadcast_to(sl, (p_count, r - 1))
             j2 = jnp.argmax(sl, axis=1)
             sbest = jnp.take_along_axis(sl, j2[:, None], axis=1)[:, 0]
@@ -217,8 +227,7 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
             act = jax.tree_util.tree_map(lambda f: f[i], sel)
             gs_c = gs  # thresholds stay fixed within a round (initGoalState)
             mask = _structural_mask(static, agg_c, act)
-            for g, pgs in zip(priors, prior_states):
-                mask = mask & g.acceptance(static, pgs, agg_c, act)
+            mask = mask & tables_acceptance(static, tables, agg_c, act)
             mask = mask & goal.acceptance(static, gs_c, agg_c, act)
             score = goal.action_score(static, gs_c, agg_c, act)
             evac = static.dead[act.src] & ((act.kind == KIND_MOVE) | (act.dleader > 0))
@@ -241,20 +250,25 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         )
 
     def goal_step(static: StaticCtx, agg: Aggregates):
+        # Bounds are invariant under moves within a run (total load/count and
+        # capacities don't change), so the merged tables are built once per
+        # goal step — the values they're checked against stay live.
+        tables = build_tables(priors, static, agg, dims)
+
         def cond(c):
             _, rnd, done = c
             return (rnd < settings.max_rounds_per_goal) & ~done
 
         def body(c):
             agg_c, rnd, _ = c
-            agg2, applied = one_round(static, agg_c)
+            agg2, applied = one_round(static, agg_c, tables)
             if swap_fn is not None:
                 # swaps only when plain moves stalled, matching the
                 # reference's move-first-then-swap order
                 agg2, swap_applied = jax.lax.cond(
                     applied,
                     lambda a: (a, jnp.asarray(False)),
-                    lambda a: swap_fn(static, a),
+                    lambda a: swap_fn(static, a, tables),
                     agg2,
                 )
                 applied = applied | swap_applied
@@ -279,6 +293,58 @@ def _cached_goal_step(goal_name: str, prior_names: Tuple[str, ...], dims: Dims,
     goal = GOAL_REGISTRY[goal_name]
     priors = tuple(GOAL_REGISTRY[n] for n in prior_names)
     return _make_goal_step(goal, priors, dims, settings)
+
+
+#: AOT-compiled goal steps, keyed on (goal, priors, dims, settings, mesh),
+#: LRU-bounded (~6 dims variants of a 15-goal stack). XLA compilation releases
+#: the GIL, so a thread pool compiles the whole stack concurrently — the
+#: production analog of GoalOptimizer's background proposal precompute warming
+#: its caches (cc/analyzer/GoalOptimizer.java:129).
+_COMPILED_STEPS: "collections.OrderedDict" = collections.OrderedDict()
+_COMPILED_STEPS_MAX = 90
+_BUILD_LOCK = threading.Lock()
+
+
+def _precompile_steps(goals, static, agg, dims, settings, mesh):
+    """Compile every goal step concurrently; returns {goal name: callable}.
+
+    Worker count is clamped to the host's cores — with one core, threads only
+    thrash XLA's own compilation parallelism, so the build runs sequentially.
+    The whole build happens under one lock so concurrent optimizations() calls
+    with the same key never duplicate a stack compile.
+    """
+    import os
+
+    specs = []
+    for i, goal in enumerate(goals):
+        prior_names = tuple(g.name for g in goals[:i])
+        key = (goal.name, prior_names, dims, settings, mesh)
+        specs.append((key, goal.name, prior_names))
+    with _BUILD_LOCK:
+        todo = [s for s in specs if s[0] not in _COMPILED_STEPS]
+        if todo:
+            def build(spec):
+                key, name, prior_names = spec
+                step = _cached_goal_step(name, prior_names, dims, settings)
+                return key, step.lower(static, agg).compile()
+
+            workers = min(len(todo), max(1, os.cpu_count() or 1))
+            if workers == 1:
+                results = [build(s) for s in todo]
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(max_workers=workers) as pool:
+                    results = list(pool.map(build, todo))
+            for key, compiled in results:
+                _COMPILED_STEPS[key] = compiled
+            while len(_COMPILED_STEPS) > _COMPILED_STEPS_MAX:
+                _COMPILED_STEPS.popitem(last=False)
+        out = {}
+        for key, name, _ in specs:
+            _COMPILED_STEPS.move_to_end(key)
+            out[name] = _COMPILED_STEPS[key]
+    return out
 
 
 # -- results -------------------------------------------------------------------
@@ -383,24 +449,42 @@ class GoalOptimizer:
         t0 = time.monotonic()
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
-        if self._mesh is not None:
-            from cruise_control_tpu.parallel.sharding import (
-                pad_partitions,
-                place_aggregates,
-                place_static,
-                shard_model,
-            )
+        from cruise_control_tpu.parallel.sharding import (
+            pad_partitions_to,
+            partition_bucket,
+        )
 
-            model = shard_model(pad_partitions(model, self._mesh.size), self._mesh)
-            if options.excluded_partitions is not None and model.num_partitions > p_orig:
-                pad = np.ones(model.num_partitions - p_orig, dtype=bool)
+        # pad the partition axis: coarse buckets absorb topic churn (no
+        # recompiles for +-1 partition), and a mesh needs a multiple of its size
+        target_p = partition_bucket(p_orig) if self._settings.bucket_partitions else p_orig
+        if self._mesh is not None:
+            m = self._mesh.size
+            target_p = target_p + ((-target_p) % m)
+        if target_p != p_orig:
+            model = pad_partitions_to(model, target_p)
+            if options.excluded_partitions is not None:
+                pad = np.ones(target_p - p_orig, dtype=bool)
                 options = dataclasses.replace(
                     options,
                     excluded_partitions=np.concatenate(
                         [np.asarray(options.excluded_partitions, dtype=bool), pad]
                     ),
                 )
+        if self._mesh is not None:
+            from cruise_control_tpu.parallel.sharding import (
+                place_aggregates,
+                place_static,
+                shard_model,
+            )
+
+            model = shard_model(model, self._mesh)
         dims = dims_of(model)
+        if self._settings.bucket_partitions:
+            # bucket the topic axis too: topic add/remove changes num_topics,
+            # which would otherwise recompile the stack (hi_topic[T] and
+            # topic_replica_count[T, B] shapes); padded topic rows hold zero
+            # replicas and bounds [0, 0], so they are inert.
+            dims = dataclasses.replace(dims, num_topics=partition_bucket(dims.num_topics))
         static = build_static_ctx(model, self._constraint, dims, options)
         init_assignment = jnp.asarray(model.assignment)
         agg = compute_aggregates(static, init_assignment, dims)
@@ -410,11 +494,23 @@ class GoalOptimizer:
 
         stats_before = _jit_compute_stats(model, dims.num_topics)
 
+        compiled_steps = None
+        if self._settings.parallel_compile:
+            try:
+                compiled_steps = _precompile_steps(
+                    goals, static, agg, dims, self._settings, self._mesh
+                )
+            except Exception:  # pragma: no cover - defensive: jit path still works
+                compiled_steps = None
+
         goal_results: List[GoalResult] = []
         prior_names: Tuple[str, ...] = ()
         for goal in goals:
             g0 = time.monotonic()
-            step = _cached_goal_step(goal.name, prior_names, dims, self._settings)
+            if compiled_steps is not None:
+                step = compiled_steps[goal.name]
+            else:
+                step = _cached_goal_step(goal.name, prior_names, dims, self._settings)
             gs = goal.prepare(static, agg, dims)
             viol_before = int(jnp.sum(goal.broker_violation(static, gs, agg)))
             cost_before = float(goal.cost(static, gs, agg))

@@ -32,6 +32,7 @@ from cruise_control_tpu.analyzer.actions import (
     _leader_vec,
     build_selected,
 )
+from cruise_control_tpu.analyzer.acceptance import tables_acceptance
 from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, apply_action
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS
 from cruise_control_tpu.common.resources import PartMetric, Resource
@@ -58,19 +59,19 @@ def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Arr
 
 
 def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
-    """Build swap_round(static, agg) -> (agg, applied_any) for a
+    """Build swap_round(static, agg, tables) -> (agg, applied_any) for a
     resource-distribution goal (jit-compatible; call inside the goal loop).
 
-    `priors` are the already-optimized goals: both directions of every
-    candidate swap must pass each prior's acceptance kernel, the same
-    invariant the move path enforces per candidate."""
+    `tables` are the merged acceptance bounds of the already-optimized goals
+    (analyzer.acceptance): both directions of every candidate swap must pass
+    them, the same invariant the move path enforces per candidate."""
     res = goal.resource
     p_count, r = dims.num_partitions, dims.max_rf
     n_pairs = max(1, min(n_pairs, dims.num_brokers // 2 or 1))
     k = max(1, min(k, p_count))
-    priors = tuple(priors)
+    del priors  # prior-goal invariants arrive via the merged tables
 
-    def swap_round(static: StaticCtx, agg: Aggregates):
+    def swap_round(static: StaticCtx, agg: Aggregates, tables):
         gs = goal.prepare(static, agg, dims)
         cap = jnp.maximum(static.broker_capacity[:, res], 1e-9)
         util = agg.broker_load[:, res] / cap
@@ -119,10 +120,8 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
             static.part_load, agg.assignment,
             cp[:, None, :], jnp.int32(KIND_MOVE), cs[:, None, :], hot[:, None, None],
         )
-        for g in priors:
-            pgs = g.prepare(static, agg, dims)
-            ok &= g.acceptance(static, pgs, agg, mv1b)
-            ok &= g.acceptance(static, pgs, agg, mv2b)
+        ok &= tables_acceptance(static, tables, agg, mv1b)
+        ok &= tables_acceptance(static, tables, agg, mv2b)
 
         # neither broker may already host the other's partition
         cold_b = cold[:, None, None]

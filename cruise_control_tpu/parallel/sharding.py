@@ -54,8 +54,8 @@ def _replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
 
 
-def pad_partitions(model: FlatClusterModel, multiple: int) -> FlatClusterModel:
-    """Pad the partition axis to a multiple of the mesh size.
+def pad_partitions_to(model: FlatClusterModel, target: int) -> FlatClusterModel:
+    """Pad the partition axis up to exactly `target` rows.
 
     Padding rows are fully-invalid partitions (`assignment == -1` in every
     slot, zero load): every candidate built from them fails the structural
@@ -63,8 +63,8 @@ def pad_partitions(model: FlatClusterModel, multiple: int) -> FlatClusterModel:
     they contribute to no aggregate and generate no proposals.
     """
     p = model.num_partitions
-    pad = (-p) % multiple
-    if pad == 0:
+    pad = target - p
+    if pad <= 0:
         return model
     a = np.asarray(model.assignment)
     load = np.asarray(model.part_load)
@@ -78,6 +78,30 @@ def pad_partitions(model: FlatClusterModel, multiple: int) -> FlatClusterModel:
         ),
         topic_id=np.concatenate([topic, np.zeros(pad, dtype=topic.dtype)], axis=0),
     )
+
+
+def pad_partitions(model: FlatClusterModel, multiple: int) -> FlatClusterModel:
+    """Pad the partition axis to a multiple of the mesh size."""
+    p = model.num_partitions
+    return pad_partitions_to(model, p + ((-p) % multiple))
+
+
+def size_bucket(n: int) -> int:
+    """Round an axis size up to a coarse bucket (1/8 granularity).
+
+    Keyed into the goal-step compile cache through `Dims`, this keeps churn
+    (partition create/delete, topic add/remove) from recompiling the whole
+    goal stack: any size inside the same bucket reuses the padded program.
+    Padding overhead is bounded at 12.5%; tiny fixtures (<= 64) are left exact.
+    """
+    if n <= 64:
+        return n
+    step = max(8, 1 << (n.bit_length() - 4))
+    return ((n + step - 1) // step) * step
+
+
+#: historical name for the partition-axis use
+partition_bucket = size_bucket
 
 
 def shard_model(model: FlatClusterModel, mesh: Mesh) -> FlatClusterModel:

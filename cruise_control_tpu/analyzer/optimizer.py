@@ -20,8 +20,17 @@ actionAcceptance, then mutate the model (:186-227) — becomes, per round:
      one-action-at-a-time correctness while amortizing the search.
 
 With batch_k=1 this degrades to a faithful greedy (the parity mode used by the
-benchmark harness). The whole per-goal loop is one jitted lax.while_loop, so a
-full optimization run is a handful of XLA executions.
+benchmark harness).
+
+The ENTIRE goal stack runs as ONE jitted XLA program: the priority loop over
+goals is unrolled at trace time (the goal sequence is static), each goal's
+while_loop body follows the previous goal's, and the per-goal before/after
+diagnostics (violated-broker counts, costs, round counts) are computed
+in-graph and fetched with a single host transfer at the end. Compared with
+one program per goal this (a) costs one XLA compile per problem shape instead
+of |goals|, and (b) removes every per-goal host round-trip — the reference's
+per-goal stats snapshots (GoalOptimizer.java:442) become rows of stacked
+device arrays instead of blocking reads.
 """
 
 from __future__ import annotations
@@ -31,7 +40,7 @@ import dataclasses
 import functools
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,7 +66,7 @@ from cruise_control_tpu.analyzer.context import (
     dims_of,
     dst_hosts_partition,
 )
-from cruise_control_tpu.analyzer.acceptance import build_tables, tables_acceptance
+from cruise_control_tpu.analyzer.acceptance import empty_tables, tables_acceptance
 from cruise_control_tpu.analyzer.goals import goals_by_priority
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
@@ -75,6 +84,7 @@ class OptimizationFailureException(Exception):
 #: Module-level so the compile cache survives across optimizations() calls
 #: (the production regime: the precompute loop reuses compiled kernels).
 _jit_compute_stats = jax.jit(compute_stats, static_argnums=1)
+_jit_compute_aggregates = jax.jit(compute_aggregates, static_argnums=2)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,8 +102,6 @@ class OptimizerSettings:
     #: (partition/topic create/delete) reuses compiled goal steps instead of
     #: recompiling; broker churn still recompiles (rare in practice)
     bucket_partitions: bool = True
-    #: AOT-compile all goal steps concurrently before the first goal runs
-    parallel_compile: bool = True
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -166,8 +174,13 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
 # with the swap kernel)
 
 
-def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: OptimizerSettings):
-    """Build the jitted per-goal optimization loop (rounds until no progress)."""
+def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
+    """Build the per-goal optimization loop (rounds until no progress).
+
+    Returns goal_loop(static, agg, tables) -> (agg, rounds). NOT jitted —
+    it is traced as one segment of the fused whole-stack program
+    (_make_stack_step); `tables` are the merged acceptance bounds of the
+    goals already optimized before this one."""
     p_count, r = dims.num_partitions, dims.max_rf
     k_dst = max(1, min(settings.num_dst_candidates, dims.num_racks))
     k_sel = max(1, min(settings.batch_k, p_count))
@@ -246,15 +259,10 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         from cruise_control_tpu.analyzer.swaps import make_swap_round
 
         swap_fn = make_swap_round(
-            goal, priors, dims, settings.num_swap_pairs, settings.swap_candidates
+            goal, (), dims, settings.num_swap_pairs, settings.swap_candidates
         )
 
-    def goal_step(static: StaticCtx, agg: Aggregates):
-        # Bounds are invariant under moves within a run (total load/count and
-        # capacities don't change), so the merged tables are built once per
-        # goal step — the values they're checked against stay live.
-        tables = build_tables(priors, static, agg, dims)
-
+    def goal_loop(static: StaticCtx, agg: Aggregates, tables):
         def cond(c):
             _, rnd, done = c
             return (rnd < settings.max_rounds_per_goal) & ~done
@@ -277,74 +285,96 @@ def _make_goal_step(goal: Goal, priors: Tuple[Goal, ...], dims: Dims, settings: 
         final_agg, rounds, _ = jax.lax.while_loop(
             cond, body, (agg, jnp.int32(0), jnp.asarray(False))
         )
-        gs = goal.prepare(static, final_agg, dims)
-        violated = goal.broker_violation(static, gs, final_agg)
-        cost = goal.cost(static, gs, final_agg)
-        return final_agg, rounds, violated, cost
+        return final_agg, rounds
 
-    return jax.jit(goal_step)
+    return goal_loop
 
 
-@functools.lru_cache(maxsize=256)
-def _cached_goal_step(goal_name: str, prior_names: Tuple[str, ...], dims: Dims,
-                      settings: OptimizerSettings):
+class StackMetrics(NamedTuple):
+    """Per-goal diagnostics of one fused stack run; row i = i-th goal.
+
+    The device-array form of the reference's per-goal stats snapshots
+    (GoalOptimizer.java:442): everything the host needs afterwards comes back
+    in ONE transfer instead of 4 blocking reads per goal."""
+
+    violated_before: jax.Array  # i32[G]
+    violated_after: jax.Array  # i32[G]
+    cost_before: jax.Array  # f32[G]
+    cost_after: jax.Array  # f32[G]
+    rounds: jax.Array  # i32[G]
+
+
+def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+    """Fuse the whole priority-ordered goal stack into one jitted program.
+
+    The goal sequence is static, so the priority loop unrolls at trace time:
+    goal i's while_loop feeds goal i+1's. Prior-goal acceptance accumulates
+    in the merged AcceptanceTables — each finished goal contributes its box
+    constraints once (bounds are invariant under moves within a run: total
+    load/count and capacities don't change), which is exactly what the old
+    per-goal build_tables recomputed from scratch each step.
+    """
     from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
 
-    goal = GOAL_REGISTRY[goal_name]
-    priors = tuple(GOAL_REGISTRY[n] for n in prior_names)
-    return _make_goal_step(goal, priors, dims, settings)
+    goals = [GOAL_REGISTRY[n] for n in goal_names]
+    loops = [_make_goal_loop(g, dims, settings) for g in goals]
+
+    def stack_step(static: StaticCtx, agg: Aggregates):
+        tables = empty_tables(dims)
+        vb, va, cb, ca, rs = [], [], [], [], []
+        for goal, loop in zip(goals, loops):
+            gs0 = goal.prepare(static, agg, dims)
+            vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
+            cb.append(goal.cost(static, gs0, agg).astype(jnp.float32))
+            agg, rounds = loop(static, agg, tables)
+            gs1 = goal.prepare(static, agg, dims)
+            va.append(jnp.sum(goal.broker_violation(static, gs1, agg)).astype(jnp.int32))
+            ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
+            rs.append(rounds)
+            tables = goal.contribute_acceptance(static, gs1, tables)
+        metrics = StackMetrics(
+            violated_before=jnp.stack(vb),
+            violated_after=jnp.stack(va),
+            cost_before=jnp.stack(cb),
+            cost_after=jnp.stack(ca),
+            rounds=jnp.stack(rs),
+        )
+        return agg, metrics
+
+    return jax.jit(stack_step)
 
 
-#: AOT-compiled goal steps, keyed on (goal, priors, dims, settings, mesh),
-#: LRU-bounded (~6 dims variants of a 15-goal stack). XLA compilation releases
-#: the GIL, so a thread pool compiles the whole stack concurrently — the
-#: production analog of GoalOptimizer's background proposal precompute warming
-#: its caches (cc/analyzer/GoalOptimizer.java:129).
-_COMPILED_STEPS: "collections.OrderedDict" = collections.OrderedDict()
-_COMPILED_STEPS_MAX = 90
+@functools.lru_cache(maxsize=32)
+def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+    """One fused program per (goal stack, dims, settings)."""
+    return _make_stack_step(goal_names, dims, settings)
+
+
+#: AOT-compiled stack executables, keyed on (goal stack, dims, settings,
+#: mesh), built under one lock so concurrent optimizations() calls never
+#: duplicate a stack compile (lru_cache alone does not coalesce in-flight
+#: misses, and a duplicated config-5 compile costs minutes). Combined with the
+#: dim buckets (parallel.sharding.size_bucket) and the persistent compilation
+#: cache (cruise_control_tpu.compile_cache), a production deployment compiles
+#: the stack once, ever.
+_COMPILED_STACKS: "collections.OrderedDict" = collections.OrderedDict()
+_COMPILED_STACKS_MAX = 16
 _BUILD_LOCK = threading.Lock()
 
 
-def _precompile_steps(goals, static, agg, dims, settings, mesh):
-    """Compile every goal step concurrently; returns {goal name: callable}.
-
-    Worker count is clamped to the host's cores — with one core, threads only
-    thrash XLA's own compilation parallelism, so the build runs sequentially.
-    The whole build happens under one lock so concurrent optimizations() calls
-    with the same key never duplicate a stack compile.
-    """
-    import os
-
-    specs = []
-    for i, goal in enumerate(goals):
-        prior_names = tuple(g.name for g in goals[:i])
-        key = (goal.name, prior_names, dims, settings, mesh)
-        specs.append((key, goal.name, prior_names))
+def _stack_executable(goal_names, dims, settings, mesh, static, agg):
+    key = (goal_names, dims, settings, mesh)
     with _BUILD_LOCK:
-        todo = [s for s in specs if s[0] not in _COMPILED_STEPS]
-        if todo:
-            def build(spec):
-                key, name, prior_names = spec
-                step = _cached_goal_step(name, prior_names, dims, settings)
-                return key, step.lower(static, agg).compile()
-
-            workers = min(len(todo), max(1, os.cpu_count() or 1))
-            if workers == 1:
-                results = [build(s) for s in todo]
-            else:
-                from concurrent.futures import ThreadPoolExecutor
-
-                with ThreadPoolExecutor(max_workers=workers) as pool:
-                    results = list(pool.map(build, todo))
-            for key, compiled in results:
-                _COMPILED_STEPS[key] = compiled
-            while len(_COMPILED_STEPS) > _COMPILED_STEPS_MAX:
-                _COMPILED_STEPS.popitem(last=False)
-        out = {}
-        for key, name, _ in specs:
-            _COMPILED_STEPS.move_to_end(key)
-            out[name] = _COMPILED_STEPS[key]
-    return out
+        ex = _COMPILED_STACKS.get(key)
+        if ex is None:
+            step = _cached_stack_step(goal_names, dims, settings)
+            ex = step.lower(static, agg).compile()
+            _COMPILED_STACKS[key] = ex
+            while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
+                _COMPILED_STACKS.popitem(last=False)
+        else:
+            _COMPILED_STACKS.move_to_end(key)
+    return ex
 
 
 # -- results -------------------------------------------------------------------
@@ -443,9 +473,16 @@ class GoalOptimizer:
         raise_on_hard_failure: bool = True,
         progress=None,
     ) -> OptimizerResult:
-        """`progress`: optional callable(goal_name, seconds) invoked after each
-        goal finishes — the analog of the reference's OperationProgress steps
-        (cc/async/progress/OptimizationForGoal)."""
+        """Runs the requested goal stack and diffs initial vs final placement.
+
+        The stack executes as ONE fused XLA program, so hard-goal failures
+        raise only after the whole stack ran (the reference stops at the first
+        hard failure mid-stack; the outcome for the caller is the same
+        exception), and `progress` — the analog of the reference's
+        OperationProgress steps (cc/async/progress/OptimizationForGoal) — is
+        invoked per goal in one burst AFTER the stack completes, with each
+        goal's round-share of the measured stack wall-clock (an attribution,
+        not a per-goal measurement; compile time is excluded)."""
         t0 = time.monotonic()
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
@@ -487,63 +524,61 @@ class GoalOptimizer:
             dims = dataclasses.replace(dims, num_topics=partition_bucket(dims.num_topics))
         static = build_static_ctx(model, self._constraint, dims, options)
         init_assignment = jnp.asarray(model.assignment)
-        agg = compute_aggregates(static, init_assignment, dims)
+        agg = _jit_compute_aggregates(static, init_assignment, dims)
         if self._mesh is not None:
             static = place_static(static, self._mesh)
             agg = place_aggregates(agg, self._mesh)
 
         stats_before = _jit_compute_stats(model, dims.num_topics)
 
-        compiled_steps = None
-        if self._settings.parallel_compile:
-            try:
-                compiled_steps = _precompile_steps(
-                    goals, static, agg, dims, self._settings, self._mesh
-                )
-            except Exception:  # pragma: no cover - defensive: jit path still works
-                compiled_steps = None
-
-        goal_results: List[GoalResult] = []
-        prior_names: Tuple[str, ...] = ()
-        for goal in goals:
-            g0 = time.monotonic()
-            if compiled_steps is not None:
-                step = compiled_steps[goal.name]
-            else:
-                step = _cached_goal_step(goal.name, prior_names, dims, self._settings)
-            gs = goal.prepare(static, agg, dims)
-            viol_before = int(jnp.sum(goal.broker_violation(static, gs, agg)))
-            cost_before = float(goal.cost(static, gs, agg))
-            agg, rounds, violated, cost = step(static, agg)
-            viol_after = int(jnp.sum(violated))
-            goal_results.append(
-                GoalResult(
-                    name=goal.name,
-                    is_hard=goal.is_hard,
-                    violated_brokers_before=viol_before,
-                    violated_brokers_after=viol_after,
-                    cost_before=cost_before,
-                    cost_after=float(cost),
-                    rounds=int(rounds),
-                    duration_s=time.monotonic() - g0,
-                )
-            )
-            if progress is not None:
-                progress(goal.name, time.monotonic() - g0)
-            if goal.is_hard and viol_after > 0 and raise_on_hard_failure:
-                raise OptimizationFailureException(
-                    f"hard goal {goal.name} still violated on {viol_after} broker(s)"
-                )
-            prior_names = prior_names + (goal.name,)
+        step = _stack_executable(
+            tuple(g.name for g in goals), dims, self._settings, self._mesh, static, agg
+        )
+        t_stack = time.monotonic()
+        agg, metrics = step(static, agg)
+        jax.block_until_ready(metrics)
+        stack_s = time.monotonic() - t_stack
 
         final_model = model._replace(assignment=agg.assignment)
         stats_after = _jit_compute_stats(final_model, dims.num_topics)
 
+        # ONE host transfer for everything the result needs (the device sync
+        # point of the whole run).
+        metrics, stats_before, stats_after, init_np, final_np = jax.device_get(
+            (metrics, stats_before, stats_after, init_assignment, agg.assignment)
+        )
+
+        goal_results: List[GoalResult] = []
+        first_hard_failure: Optional[GoalResult] = None
+        for i, goal in enumerate(goals):
+            gr = GoalResult(
+                name=goal.name,
+                is_hard=goal.is_hard,
+                violated_brokers_before=int(metrics.violated_before[i]),
+                violated_brokers_after=int(metrics.violated_after[i]),
+                cost_before=float(metrics.cost_before[i]),
+                cost_after=float(metrics.cost_after[i]),
+                rounds=int(metrics.rounds[i]),
+                # the stack runs as one fused XLA program; per-goal wall-clock
+                # is not observable, so attribute time by round share
+                duration_s=stack_s * int(metrics.rounds[i]) / max(1, int(metrics.rounds.sum())),
+            )
+            goal_results.append(gr)
+            if progress is not None:
+                progress(goal.name, gr.duration_s)
+            if gr.is_hard and gr.violated_brokers_after > 0 and first_hard_failure is None:
+                first_hard_failure = gr
+        if first_hard_failure is not None and raise_on_hard_failure:
+            raise OptimizationFailureException(
+                f"hard goal {first_hard_failure.name} still violated on "
+                f"{first_hard_failure.violated_brokers_after} broker(s)"
+            )
+
         # drop mesh-padding rows: pad rows never change, so proposals/stats are
         # unaffected and the returned assignment round-trips with the caller's
         # unpadded part_load.
-        init_np = np.asarray(init_assignment)[:p_orig]
-        final_np = np.asarray(agg.assignment)[:p_orig]
+        init_np = np.asarray(init_np)[:p_orig]
+        final_np = np.asarray(final_np)[:p_orig]
         proposals = proposal_diff(init_np, final_np, np.asarray(model.part_load)[:p_orig])
         n_moves = sum(len(pr.replicas_to_add) for pr in proposals)
         n_leader = sum(

@@ -1,0 +1,46 @@
+"""Persistent XLA compilation cache.
+
+The fused goal-stack program (analyzer.optimizer) costs one XLA compile per
+problem shape; this module makes that compile survive process restarts —
+the driver's warmup pass, the test suite, and production restarts all reuse
+the same on-disk executables. The reference has no analog (JVM JIT warmup is
+implicit); for an XLA-based service this is part of the startup contract.
+
+Call `enable_persistent_cache()` before the first jit execution. Safe to call
+multiple times; a no-op if the cache was already enabled with another path.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache")
+
+_enabled: Optional[str] = None
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
+    """Point JAX's compilation cache at a durable directory and drop the
+    min-compile-time / min-entry-size gates so every program is cached.
+
+    Returns the cache dir, or None when no writable directory is available
+    (read-only install and no CRUISE_CONTROL_JAX_CACHE override) — the cache
+    is an accelerator, never a startup requirement."""
+    global _enabled
+    if _enabled is not None:
+        return _enabled
+    import jax
+
+    cache_dir = os.path.abspath(
+        path or os.environ.get("CRUISE_CONTROL_JAX_CACHE", _DEFAULT_DIR)
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return None
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _enabled = cache_dir
+    return cache_dir

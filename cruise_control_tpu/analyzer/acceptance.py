@@ -44,21 +44,40 @@ from typing import NamedTuple, Sequence
 import jax
 import jax.numpy as jnp
 
-from cruise_control_tpu.analyzer.actions import ActionBatch
-from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx
+from cruise_control_tpu.analyzer.actions import (
+    DEAD_EVACUATION_BONUS,
+    KIND_MOVE,
+    ActionBatch,
+)
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    StaticCtx,
+    dst_hosts_partition,
+)
 from cruise_control_tpu.common.resources import Resource
 
 _INF = jnp.float32(jnp.inf)
 
 
 class AcceptanceTables(NamedTuple):
-    """Merged box constraints of all previously-optimized goals.
+    """Merged constraints of all previously-optimized goals.
 
     All bounds are in raw aggregate units (loads, counts); +/-inf disables.
+    `hi_load`/`lo_load` are HARD boxes (capacity goals). `band_*` carries the
+    usage-distribution goals' balance bands with the reference's TWO-CASE
+    acceptance (ResourceDistributionGoal.actionAcceptance :91-133): the box
+    applies only when both endpoints currently satisfy their side of the band;
+    otherwise any action that strictly shrinks the pairwise load difference is
+    acceptable. Collapsing the band into the hard box would freeze the model
+    whenever brokers sit outside the band — which is the normal state mid-
+    optimization at scale.
     """
 
-    hi_load: jax.Array  # f32[B, 4]
-    lo_load: jax.Array  # f32[B, 4]
+    hi_load: jax.Array  # f32[B, 4] hard upper (capacity goals)
+    lo_load: jax.Array  # f32[B, 4] hard lower (unused today; kept for symmetry)
+    band_hi: jax.Array  # f32[B, 4] distribution band upper
+    band_lo: jax.Array  # f32[B, 4] distribution band lower
+    band_on: jax.Array  # bool[4]  band contributed for this resource
     hi_rep: jax.Array  # f32[B]
     lo_rep: jax.Array  # f32[B]
     hi_lead: jax.Array  # f32[B]
@@ -77,6 +96,9 @@ def empty_tables(dims) -> AcceptanceTables:
     return AcceptanceTables(
         hi_load=jnp.full((b, 4), _INF),
         lo_load=jnp.full((b, 4), -_INF),
+        band_hi=jnp.full((b, 4), _INF),
+        band_lo=jnp.full((b, 4), -_INF),
+        band_on=jnp.zeros((4,), dtype=bool),
         hi_rep=jnp.full((b,), _INF),
         lo_rep=jnp.full((b,), -_INF),
         hi_lead=jnp.full((b,), _INF),
@@ -91,11 +113,47 @@ def empty_tables(dims) -> AcceptanceTables:
     )
 
 
+def band_move_acceptance(tables: AcceptanceTables, agg: Aggregates, src, dst, dload,
+                         dead_src) -> jax.Array:
+    """bool[...]: the two-case distribution-band check for a (possibly signed)
+    per-resource load transfer src -> dst.
+
+    Case 1 (src above its lower bound AND dst under its upper bound — both
+    endpoints currently 'fine' for the direction they're changing): the move
+    must keep them so. Case 2 (either endpoint already outside): the move
+    must strictly shrink |load_src - load_dst| — the reference's
+    isGettingMoreBalanced (:866), which is what lets optimization continue in
+    tight states. Source-side bounds are waived for dead sources.
+    """
+    s = agg.broker_load[src]  # [..., 4]
+    d = agg.broker_load[dst]
+    lo_s = tables.band_lo[src]
+    hi_s = tables.band_hi[src]
+    lo_d = tables.band_lo[dst]
+    hi_d = tables.band_hi[dst]
+    dead = dead_src[..., None]
+    pos = dload >= 0.0
+    # the endpoint losing load must sit above its lower bound, the one gaining
+    # must sit under its upper bound — roles depend on the transfer's sign
+    case1 = jnp.where(pos, (s >= lo_s) & (d <= hi_d), (d >= lo_d) & (s <= hi_s))
+    acc1_pos = (d + dload <= hi_d) & ((s - dload >= lo_s) | dead)
+    acc1_neg = (s - dload <= hi_s) & (d + dload >= lo_d)
+    acc1 = jnp.where(pos, acc1_pos, acc1_neg)
+    prev = s - d
+    acc2 = jnp.abs(prev - 2.0 * dload) < jnp.abs(prev)
+    ok = jnp.where(case1, acc1, acc2 | dead)
+    ok = ok | (dload == 0.0) | ~tables.band_on
+    return jnp.all(ok, axis=-1)
+
+
 def build_tables(
     priors: Sequence, static: StaticCtx, agg: Aggregates, dims
 ) -> AcceptanceTables:
-    """Merge every prior goal's bounds (thresholds from round-start `agg`,
-    exactly when the per-goal `prepare`/initGoalState ran before)."""
+    """Merge the given goals' bounds from the current aggregates.
+
+    The fused stack program (analyzer.optimizer._make_stack_step) accumulates
+    tables incrementally via `contribute_acceptance` as each goal finishes;
+    this helper builds the same tables in one shot for tests/analysis."""
     tables = empty_tables(dims)
     for g in priors:
         gs = g.prepare(static, agg, dims)
@@ -115,7 +173,7 @@ def tables_acceptance(
     src, dst = act.src, act.dst
     dead_src = static.dead[src]
 
-    # per-resource broker load
+    # per-resource broker load: hard capacity box ...
     d = act.dload  # [..., 4]
     load_dst_after = agg.broker_load[dst] + d
     load_src_after = agg.broker_load[src] - d
@@ -124,6 +182,8 @@ def tables_acceptance(
     ok &= dead_src | jnp.all(
         ~inc | (load_src_after >= tables.lo_load[src]), axis=-1
     )
+    # ... and the usage-distribution goals' two-case band
+    ok &= band_move_acceptance(tables, agg, src, dst, d, dead_src)
 
     # replica count
     drep = act.drep.astype(jnp.float32)
@@ -169,3 +229,117 @@ def tables_acceptance(
     ok &= ~(tables.rack_enabled & rep_inc) | (count_dst == 0)
 
     return ok
+
+
+def swap_tables_acceptance(
+    static: StaticCtx, tables: AcceptanceTables, agg: Aggregates, mv1, mv2
+) -> jax.Array:
+    """bool[...]: does a SWAP satisfy every merged bound, evaluated on its
+    NET effect?
+
+    `mv1` moves a replica hot -> cold, `mv2` moves one cold -> hot (the
+    optimizer's two-coupled-moves encoding of INTER_BROKER_REPLICA_SWAP).
+    The reference evaluates actionAcceptance on the swap action atomically
+    (AbstractGoal.maybeApplySwapAction :240); checking each leg alone against
+    the merged tables is stricter — near a bound it vetoes swaps whose net
+    load change is tiny, which is the entire point of a swap. Load-like
+    quantities (per-resource load, leader count, potential NW_OUT, leader
+    bytes-in, host CPU) are therefore checked on the net delta per broker;
+    per-topic counts stay per-leg (their deltas are +-1 regardless), skipped
+    when both replicas share a topic (net zero); replica counts don't change.
+    """
+    hot, cold = mv1.src, mv2.src
+    d = mv1.dload - mv2.dload  # [..., 4] net load cold gains (hot loses)
+
+    def box(broker, delta):
+        inc = delta > 0.0
+        after = agg.broker_load[broker] + delta
+        up = jnp.all(~inc | (after <= tables.hi_load[broker]), axis=-1)
+        lo = jnp.all(inc | (after >= tables.lo_load[broker]), axis=-1)
+        return up & lo
+
+    ok = box(cold, d) & box(hot, -d)
+    # distribution bands, two-case on the swap's net transfer hot -> cold
+    # (ResourceDistributionGoal swap acceptance :96-121: box only when both
+    # brokers currently satisfy the relevant side of the band, otherwise the
+    # swap must shrink |load_hot - load_cold|)
+    not_dead = jnp.zeros(jnp.broadcast_shapes(hot.shape, cold.shape), dtype=bool)
+    ok &= band_move_acceptance(tables, agg, hot, cold, d, not_dead)
+
+    # leader count (a swap can carry a leader slot across)
+    dl = (mv1.dleader - mv2.dleader).astype(jnp.float32)
+    ok &= (dl <= 0) | (
+        (agg.leader_count[cold] + dl <= tables.hi_lead[cold])
+        & (agg.leader_count[hot] - dl >= tables.lo_lead[hot])
+    )
+    ok &= (dl >= 0) | (
+        (agg.leader_count[hot] - dl <= tables.hi_lead[hot])
+        & (agg.leader_count[cold] + dl >= tables.lo_lead[cold])
+    )
+
+    # potential NW_OUT and leader bytes-in, net per broker
+    dpnw = mv1.dpnw - mv2.dpnw
+    ok &= (dpnw <= 0.0) | (agg.potential_nw_out[cold] + dpnw <= tables.hi_pnw[cold])
+    ok &= (dpnw >= 0.0) | (agg.potential_nw_out[hot] - dpnw <= tables.hi_pnw[hot])
+    dlnw = mv1.dleader_nw_in - mv2.dleader_nw_in
+    ok &= (dlnw <= 0.0) | (agg.leader_nw_in[cold] + dlnw <= tables.hi_lnw[cold])
+    ok &= (dlnw >= 0.0) | (agg.leader_nw_in[hot] - dlnw <= tables.hi_lnw[hot])
+
+    # per-topic counts, per-leg (+-1), inert when both replicas share a topic
+    t1 = static.topic_id[mv1.p]
+    t2 = static.topic_id[mv2.p]
+    diff_topic = t1 != t2
+    topic_ok = (
+        (agg.topic_replica_count[t1, cold] + 1 <= tables.hi_topic[t1])
+        & (agg.topic_replica_count[t1, hot] - 1 >= tables.lo_topic[t1])
+        & (agg.topic_replica_count[t2, hot] + 1 <= tables.hi_topic[t2])
+        & (agg.topic_replica_count[t2, cold] - 1 >= tables.lo_topic[t2])
+    )
+    ok &= ~diff_topic | topic_ok
+
+    # host-level CPU, net (same-host swaps shift nothing between hosts)
+    dcpu = d[..., Resource.CPU]
+    host_hot = static.broker_host[hot]
+    host_cold = static.broker_host[cold]
+    same_host = host_hot == host_cold
+    ok &= same_host | (dcpu <= 0.0) | (
+        agg.host_cpu_load[host_cold] + dcpu <= tables.hi_host_cpu[host_cold]
+    )
+    ok &= same_host | (dcpu >= 0.0) | (
+        agg.host_cpu_load[host_hot] - dcpu <= tables.hi_host_cpu[host_hot]
+    )
+    return ok
+
+
+def structural_mask(static: StaticCtx, agg: Aggregates, act: ActionBatch):
+    """Checks every action must pass regardless of goals: the dense analog of
+    GoalUtils.legitMove + OptimizationOptions filtering."""
+    is_move = act.kind == KIND_MOVE
+    ok = act.valid & static.movable_partition[act.p]
+    ok = ok & jnp.where(
+        is_move, static.replica_dst_ok[act.dst], static.leadership_dst_ok[act.dst]
+    )
+    ok = ok & ~(is_move & dst_hosts_partition(agg, act.p, act.dst))
+    ok = ok & ((~static.only_move_immigrants) | static.dead[act.src])
+    return ok
+
+
+from cruise_control_tpu.analyzer.goals.base import SCORE_EPS as _SCORE_EPS  # noqa: E402
+
+
+def score_batch(static: StaticCtx, agg: Aggregates, act: ActionBatch, goal, gs, tables):
+    """f32[...]: masked score of each candidate (-inf where unacceptable).
+
+    All prior goals' acceptance is enforced by the merged `tables` in one
+    fixed-size kernel — the program does not grow with the number of
+    previously-optimized goals."""
+    mask = structural_mask(static, agg, act)
+    mask = mask & tables_acceptance(static, tables, agg, act)
+    mask = mask & goal.acceptance(static, gs, agg, act)
+    score = goal.action_score(static, gs, agg, act)
+    # Evacuating dead brokers dominates any balance improvement: every goal
+    # must first clear replicas/leadership off dead brokers
+    # (GoalUtils.ensureNoReplicaOnDeadBrokers semantics).
+    evac = static.dead[act.src] & ((act.kind == KIND_MOVE) | (act.dleader > 0))
+    score = score + jnp.where(evac, DEAD_EVACUATION_BONUS, 0.0)
+    return jnp.where(mask & (score > _SCORE_EPS), score, -jnp.inf)

@@ -102,14 +102,22 @@ def distribution_score(before_src, before_dst, after_src, after_dst, lower, uppe
                        tiebreak=0.0):
     """Imbalance reduction on the two touched brokers, with a bounded tiebreak.
 
-    Positive only when the action strictly reduces total out-of-range distance;
-    the tiebreak (scaled to stay below SCORE_EPS-relevant magnitudes) orders
+    Positive only when the action strictly reduces total out-of-range distance
+    AND neither endpoint gets individually worse — the reference's greedy only
+    ever moves load between a broker outside its limit and one that stays
+    within it (ResourceDistributionGoal.rebalanceByMovingLoadOut/-In,
+    ReplicaDistributionAbstractGoal), so collateral "push dst out of band for
+    a bigger src gain" trades are rejected; allowing them lets an aggressive
+    batched round spread small violations across many brokers and lock the
+    model for every later goal's acceptance bounds.
+
+    The tiebreak (scaled to stay below SCORE_EPS-relevant magnitudes) orders
     equally-improving actions.
     """
-    red = (
-        imbalance(before_src, lower, upper)
-        + imbalance(before_dst, lower, upper)
-        - imbalance(after_src, lower, upper)
-        - imbalance(after_dst, lower, upper)
-    )
-    return jnp.where(red > SCORE_EPS, red + 1e-3 * jnp.tanh(tiebreak), 0.0)
+    i_src0 = imbalance(before_src, lower, upper)
+    i_dst0 = imbalance(before_dst, lower, upper)
+    i_src1 = imbalance(after_src, lower, upper)
+    i_dst1 = imbalance(after_dst, lower, upper)
+    red = i_src0 + i_dst0 - i_src1 - i_dst1
+    endpoint_ok = (i_src1 <= i_src0 + SCORE_EPS) & (i_dst1 <= i_dst0 + SCORE_EPS)
+    return jnp.where((red > SCORE_EPS) & endpoint_ok, red + 1e-3 * jnp.tanh(tiebreak), 0.0)

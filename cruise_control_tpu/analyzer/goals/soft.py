@@ -82,18 +82,28 @@ class ResourceDistributionGoal(Goal):
         return jnp.where(gs.active, jnp.sum(jnp.where(static.alive, dist, 0.0)), 0.0)
 
     def acceptance(self, static, gs, agg, act: ActionBatch):
+        """Two-case acceptance (ResourceDistributionGoal.actionAcceptance
+        :122-133): the balance-limit box applies only when source sits above
+        its lower bound and destination under its upper bound; otherwise the
+        action must strictly shrink the pairwise utilization difference
+        (isGettingMoreBalanced :866) — in tight states (brokers outside the
+        band) downhill moves stay possible."""
         res = self.resource
         dres = act.dload[..., res]
         cap_src = jnp.maximum(static.broker_capacity[act.src, res], 1e-9)
         cap_dst = jnp.maximum(static.broker_capacity[act.dst, res], 1e-9)
-        u_src_after = (agg.broker_load[act.src, res] - dres) / cap_src
-        u_dst_after = (agg.broker_load[act.dst, res] + dres) / cap_dst
-        # source-side lower bound is waived for dead sources (self-healing) —
-        # load must leave dead brokers no matter what.
-        src_ok = (u_src_after >= gs.lower) | static.dead[act.src]
-        dst_ok = u_dst_after <= gs.upper
+        u_src = agg.broker_load[act.src, res] / cap_src
+        u_dst = agg.broker_load[act.dst, res] / cap_dst
+        u_src_after = u_src - dres / cap_src
+        u_dst_after = u_dst + dres / cap_dst
+        dead = static.dead[act.src]
+        case1 = (u_src >= gs.lower) & (u_dst <= gs.upper)
+        acc1 = (u_dst_after <= gs.upper) & ((u_src_after >= gs.lower) | dead)
+        prev = u_src - u_dst
+        acc2 = jnp.abs(u_src_after - u_dst_after) < jnp.abs(prev)
+        ok = jnp.where(case1, acc1, acc2 | dead)
         relevant = jnp.abs(dres) > 0.0
-        return ~gs.active | ~relevant | (src_ok & dst_ok)
+        return ~gs.active | ~relevant | ok
 
     def action_score(self, static, gs, agg, act: ActionBatch):
         res = self.resource
@@ -114,13 +124,18 @@ class ResourceDistributionGoal(Goal):
         return -self._util(static, agg)
 
     def contribute_acceptance(self, static, gs, tables):
-        # bounds are on utilization; in raw-load units they are per-broker
+        # balance-band bounds, enforced with the two-case semantics
+        # (acceptance.band_move_acceptance) rather than as a hard box; in
+        # raw-load units the utilization band is per-broker
         cap = static.broker_capacity[:, self.resource]
         hi = jnp.where(gs.active, gs.upper * cap, jnp.inf)
         lo = jnp.where(gs.active, gs.lower * cap, -jnp.inf)
         return tables._replace(
-            hi_load=tables.hi_load.at[:, self.resource].min(hi),
-            lo_load=tables.lo_load.at[:, self.resource].max(lo),
+            band_hi=tables.band_hi.at[:, self.resource].min(hi),
+            band_lo=tables.band_lo.at[:, self.resource].max(lo),
+            band_on=tables.band_on.at[self.resource].set(
+                tables.band_on[self.resource] | gs.active
+            ),
         )
 
 

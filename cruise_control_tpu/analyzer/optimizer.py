@@ -66,7 +66,12 @@ from cruise_control_tpu.analyzer.context import (
     dims_of,
     dst_hosts_partition,
 )
-from cruise_control_tpu.analyzer.acceptance import empty_tables, tables_acceptance
+from cruise_control_tpu.analyzer.acceptance import (
+    empty_tables,
+    score_batch,
+    structural_mask,
+    tables_acceptance,
+)
 from cruise_control_tpu.analyzer.goals import goals_by_priority
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
@@ -98,6 +103,8 @@ class OptimizerSettings:
     #: hot/cold broker pairs per round x candidate replicas per broker
     num_swap_pairs: int = 8
     swap_candidates: int = 8
+    #: swaps applied per hot broker per round (sequentially re-validated)
+    swaps_per_broker: int = 4
     #: pad the partition and topic axes to coarse buckets so count churn
     #: (partition/topic create/delete) reuses compiled goal steps instead of
     #: recompiling; broker churn still recompiles (rare in practice)
@@ -115,52 +122,32 @@ class OptimizerSettings:
 
 
 # -- per-round kernels ---------------------------------------------------------
+# structural_mask / score_batch live in analyzer.acceptance (shared with the
+# distribution-round and swap kernels)
 
 
-def _structural_mask(static: StaticCtx, agg: Aggregates, act: ActionBatch):
-    """Checks every action must pass regardless of goals: the dense analog of
-    GoalUtils.legitMove + OptimizationOptions filtering."""
-    is_move = act.kind == KIND_MOVE
-    ok = act.valid & static.movable_partition[act.p]
-    ok = ok & jnp.where(
-        is_move, static.replica_dst_ok[act.dst], static.leadership_dst_ok[act.dst]
-    )
-    ok = ok & ~(is_move & dst_hosts_partition(agg, act.p, act.dst))
-    ok = ok & ((~static.only_move_immigrants) | static.dead[act.src])
-    return ok
-
-
-def _score_batch(
-    static: StaticCtx,
-    agg: Aggregates,
-    act: ActionBatch,
-    goal: Goal,
-    gs,
-    tables,
-):
-    """f32[...]: masked score of each candidate (-inf where unacceptable).
-
-    All prior goals' acceptance is enforced by the merged `tables` in one
-    fixed-size kernel (analyzer.acceptance) — the program no longer grows
-    with the number of previously-optimized goals."""
-    mask = _structural_mask(static, agg, act)
-    mask = mask & tables_acceptance(static, tables, agg, act)
-    mask = mask & goal.acceptance(static, gs, agg, act)
-    score = goal.action_score(static, gs, agg, act)
-    # Evacuating dead brokers dominates any balance improvement: every goal
-    # must first clear replicas/leadership off dead brokers
-    # (GoalUtils.ensureNoReplicaOnDeadBrokers semantics).
-    evac = static.dead[act.src] & ((act.kind == KIND_MOVE) | (act.dleader > 0))
-    score = score + jnp.where(evac, DEAD_EVACUATION_BONUS, 0.0)
-    return jnp.where(mask & (score > SCORE_EPS), score, -jnp.inf)
-
-
-def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Dims, k: int):
+def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Dims, k: int,
+                    tables=None):
     """i32[K]: best eligible broker of each of the top-k racks by the goal's
     destination preference — rack-diverse so RackAwareGoal always finds an
-    eligible rack among the candidates."""
+    eligible rack among the candidates.
+
+    Brokers with no remaining headroom under the merged prior-goal tables are
+    demoted (not excluded — if a whole rack is saturated its least-bad broker
+    still represents it): a goal's own preference (e.g. NW_IN-lightest) is
+    blind to earlier goals' bounds, and in tight regimes the preferred broker
+    per rack is often table-infeasible while a feasible one sits next to it."""
     pref = goal.dst_preference(static, gs, agg)
     pref = jnp.where(static.replica_dst_ok, pref, -jnp.inf)
+    if tables is not None:
+        headroom = (
+            jnp.all(agg.broker_load < tables.hi_load, axis=1)
+            & (agg.replica_count < tables.hi_rep)
+            & (agg.potential_nw_out < tables.hi_pnw)
+            & (agg.leader_nw_in < tables.hi_lnw)
+        )
+        span = 1.0 + jnp.max(jnp.abs(jnp.where(jnp.isfinite(pref), pref, 0.0)))
+        pref = jnp.where(headroom, pref, pref - 2.0 * span)
     nr = dims.num_racks
     rack_mask = static.broker_rack[None, :] == jnp.arange(nr)[:, None]  # [NR, B]
     per_rack = jnp.where(rack_mask, pref[None, :], -jnp.inf)
@@ -190,7 +177,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         gs = goal.prepare(static, agg, dims)
 
         # ---- move family: [P, R, K] grid
-        dst_cands = _dst_candidates(static, gs, agg, goal, dims, k_dst)
+        dst_cands = _dst_candidates(static, gs, agg, goal, dims, k_dst, tables)
         kk = dst_cands.shape[0]
         best_score = jnp.full((p_count,), -jnp.inf)
         best_kind = jnp.zeros((p_count,), dtype=jnp.int32)
@@ -199,7 +186,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
 
         if goal.uses_moves:
             mv = make_move_batch(static.part_load, agg.assignment, dst_cands)
-            s = _score_batch(static, agg, mv, goal, gs, tables)
+            s = score_batch(static, agg, mv, goal, gs, tables)
             s = jnp.broadcast_to(s, (p_count, r, kk)).reshape(p_count, r * kk)
             j = jnp.argmax(s, axis=1)
             sm = jnp.take_along_axis(s, j[:, None], axis=1)[:, 0]
@@ -211,7 +198,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # ---- leadership family: [P, R-1] grid
         if use_leadership:
             lb = make_leadership_batch(static.part_load, agg.assignment)
-            sl = _score_batch(static, agg, lb, goal, gs, tables)
+            sl = score_batch(static, agg, lb, goal, gs, tables)
             sl = jnp.broadcast_to(sl, (p_count, r - 1))
             j2 = jnp.argmax(sl, axis=1)
             sbest = jnp.take_along_axis(sl, j2[:, None], axis=1)[:, 0]
@@ -239,7 +226,36 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             agg_c, applied_any = carry
             act = jax.tree_util.tree_map(lambda f: f[i], sel)
             gs_c = gs  # thresholds stay fixed within a round (initGoalState)
-            mask = _structural_mask(static, agg_c, act)
+            if goal.uses_moves:
+                # Re-choose the destination under the CURRENT aggregates: the
+                # shortlist's dst was the argmax against round-start state, and
+                # applying many stale-dst actions piles load onto the brokers
+                # that looked best at round start — a worse local optimum than
+                # the reference greedy, which re-argmaxes after every action.
+                # The original dst rides along as the last candidate so the
+                # re-choice can never lose an action the shortlist had.
+                cands = jnp.concatenate([dst_cands, act.dst[None]])
+                nk = cands.shape[0]
+                is_move = act.kind == KIND_MOVE
+                candK = build_selected(
+                    static.part_load,
+                    agg_c.assignment,
+                    jnp.broadcast_to(act.p, (nk,)),
+                    jnp.broadcast_to(act.kind, (nk,)),
+                    jnp.broadcast_to(act.slot, (nk,)),
+                    cands,
+                )
+                s_k = score_batch(static, agg_c, candK, goal, gs_c, tables)
+                best_dst = cands[jnp.argmax(s_k)]
+                # leadership "dst" is wherever slot's replica lives NOW
+                fresh_dst = jnp.where(
+                    is_move, best_dst, agg_c.assignment[act.p, act.slot]
+                )
+                act = build_selected(
+                    static.part_load, agg_c.assignment, act.p, act.kind,
+                    act.slot, fresh_dst,
+                )
+            mask = structural_mask(static, agg_c, act)
             mask = mask & tables_acceptance(static, tables, agg_c, act)
             mask = mask & goal.acceptance(static, gs_c, agg_c, act)
             score = goal.action_score(static, gs_c, agg_c, act)
@@ -255,21 +271,43 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         return agg2, applied_any
 
     swap_fn = None
+    dist_fn = None
     if getattr(goal, "uses_swaps", False):
-        from cruise_control_tpu.analyzer.swaps import make_swap_round
+        from cruise_control_tpu.analyzer.swaps import (
+            make_distribution_round,
+            make_swap_round,
+        )
 
         swap_fn = make_swap_round(
-            goal, (), dims, settings.num_swap_pairs, settings.swap_candidates
+            goal, (), dims, settings.num_swap_pairs, settings.swap_candidates,
+            settings.swaps_per_broker,
+        )
+        # resource-distribution goals replace the global [P, R, K] shortlist
+        # with the reference-shaped drain/fill round: per-broker steepest
+        # descent keeps near-greedy action quality (the global top-k shortlist
+        # measurably degrades the reachable optimum as batch_k grows) and its
+        # grid cost is independent of P
+        dist_fn = make_distribution_round(
+            goal, dims,
+            n_hot=max(16, settings.num_swap_pairs),
+            k_rep=max(16, settings.swap_candidates),
+            j_apply=settings.swaps_per_broker,
+            k_dst=k_dst,
         )
 
     def goal_loop(static: StaticCtx, agg: Aggregates, tables):
+        gs0 = goal.prepare(static, agg, dims)
+
         def cond(c):
             _, rnd, done = c
             return (rnd < settings.max_rounds_per_goal) & ~done
 
         def body(c):
             agg_c, rnd, _ = c
-            agg2, applied = one_round(static, agg_c, tables)
+            if dist_fn is not None:
+                agg2, applied = dist_fn(static, agg_c, tables, gs0)
+            else:
+                agg2, applied = one_round(static, agg_c, tables)
             if swap_fn is not None:
                 # swaps only when plain moves stalled, matching the
                 # reference's move-first-then-swap order

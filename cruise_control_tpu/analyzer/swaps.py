@@ -27,12 +27,13 @@ import jax
 import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.actions import (
+    KIND_LEADERSHIP,
     KIND_MOVE,
     _follower_vec,
     _leader_vec,
     build_selected,
 )
-from cruise_control_tpu.analyzer.acceptance import tables_acceptance
+from cruise_control_tpu.analyzer.acceptance import swap_tables_acceptance
 from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, apply_action
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS
 from cruise_control_tpu.common.resources import PartMetric, Resource
@@ -58,13 +59,17 @@ def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Arr
     return jnp.where(is_leader, lead[:, None], foll[:, None])
 
 
-def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
+def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
+                    swaps_per_broker: int = 4):
     """Build swap_round(static, agg, tables) -> (agg, applied_any) for a
     resource-distribution goal (jit-compatible; call inside the goal loop).
 
     `tables` are the merged acceptance bounds of the already-optimized goals
-    (analyzer.acceptance): both directions of every candidate swap must pass
-    them, the same invariant the move path enforces per candidate."""
+    (analyzer.acceptance): every candidate swap's NET effect must pass them,
+    the same invariant the move path enforces per candidate. Each round
+    applies up to `swaps_per_broker` swaps per hot broker (sequentially
+    re-validated) — in tight regimes where swaps are the only legal action,
+    per-round throughput decides how many rounds convergence takes."""
     res = goal.resource
     p_count, r = dims.num_partitions, dims.max_rf
     n_pairs = max(1, min(n_pairs, dims.num_brokers // 2 or 1))
@@ -83,9 +88,15 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
         hot_vals, hot = jax.lax.top_k(hot_rank, n_pairs)  # i32[N]
         cold_rank = jnp.where(static.alive & static.replica_dst_ok, -util, -jnp.inf)
         cold_vals, cold = jax.lax.top_k(cold_rank, n_pairs)  # i32[N]
+        # full hot x cold cross product [NH, NC, K, K]: rank-matched pairing
+        # (hot_i only with cold_i) stalls as soon as a few extreme brokers
+        # have no compatible exchange — under tight prior-goal bounds (e.g. a
+        # balanced-disk table) finding a *compatible* partner is the whole
+        # search problem, so every hot broker considers every cold broker.
         pair_ok = (
-            jnp.isfinite(hot_vals)[:, None, None]
-            & jnp.isfinite(cold_vals)[:, None, None]
+            jnp.isfinite(hot_vals)[:, None, None, None]
+            & jnp.isfinite(cold_vals)[None, :, None, None]
+            & (hot[:, None, None, None] != cold[None, :, None, None])
             & ~static.only_move_immigrants
         )
 
@@ -106,84 +117,99 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
         hp, hs, hl = jax.vmap(lambda b: pick(b, True))(hot)  # [N, K] each
         cp, cs, cl = jax.vmap(lambda b: pick(b, False))(cold)
 
-        # [N, K, K] swap grid: replica a of hot_i <-> replica b of cold_i
-        delta = hl[:, :, None] - cl[:, None, :]  # load moved hot -> cold
+        # [NH, NC, K, K] swap grid: replica a of hot_i <-> replica b of cold_j
+        delta = hl[:, None, :, None] - cl[None, :, None, :]  # load moved hot -> cold
         ok = jnp.isfinite(delta) & (delta > SCORE_EPS) & pair_ok
-        ok &= hp[:, :, None] != cp[:, None, :]
+        ok &= hp[:, None, :, None] != cp[None, :, None, :]
 
-        # every previously-optimized goal must accept BOTH directions
+        # every previously-optimized goal must accept the swap's NET effect
+        # (atomic swap acceptance, AbstractGoal.maybeApplySwapAction :240)
+        hot_b = hot[:, None, None, None]
+        cold_b = cold[None, :, None, None]
         mv1b = build_selected(
             static.part_load, agg.assignment,
-            hp[:, :, None], jnp.int32(KIND_MOVE), hs[:, :, None], cold[:, None, None],
+            hp[:, None, :, None], jnp.int32(KIND_MOVE), hs[:, None, :, None], cold_b,
         )
         mv2b = build_selected(
             static.part_load, agg.assignment,
-            cp[:, None, :], jnp.int32(KIND_MOVE), cs[:, None, :], hot[:, None, None],
+            cp[None, :, None, :], jnp.int32(KIND_MOVE), cs[None, :, None, :], hot_b,
         )
-        ok &= tables_acceptance(static, tables, agg, mv1b)
-        ok &= tables_acceptance(static, tables, agg, mv2b)
+        ok &= swap_tables_acceptance(static, tables, agg, mv1b, mv2b)
 
         # neither broker may already host the other's partition
-        cold_b = cold[:, None, None]
-        hot_b = hot[:, None, None]
-        ok &= ~jnp.any(agg.assignment[hp[:, :, None]] == cold_b[..., None], axis=-1)
-        ok &= ~jnp.any(agg.assignment[cp[:, None, :]] == hot_b[..., None], axis=-1)
+        ok &= ~jnp.any(agg.assignment[hp[:, None, :, None]] == cold_b[..., None], axis=-1)
+        ok &= ~jnp.any(agg.assignment[cp[None, :, None, :]] == hot_b[..., None], axis=-1)
 
         # rack safety for both directions (RackAwareGoal acceptance)
-        rack_hot = static.broker_rack[hot][:, None, None]
-        rack_cold = static.broker_rack[cold][:, None, None]
+        rack_hot = static.broker_rack[hot][:, None, None, None]
+        rack_cold = static.broker_rack[cold][None, :, None, None]
         same_rack = rack_hot == rack_cold
-        cnt1 = agg.rack_replica_count[hp[:, :, None], jnp.broadcast_to(rack_cold, hp[:, :, None].shape)]
+        full = (n_pairs, n_pairs, k, k)
+        cnt1 = agg.rack_replica_count[
+            jnp.broadcast_to(hp[:, None, :, None], full), jnp.broadcast_to(rack_cold, full)
+        ]
         ok &= (cnt1 - same_rack.astype(cnt1.dtype)) == 0
-        cnt2 = agg.rack_replica_count[cp[:, None, :], jnp.broadcast_to(rack_hot, cp[:, None, :].shape)]
+        cnt2 = agg.rack_replica_count[
+            jnp.broadcast_to(cp[None, :, None, :], full), jnp.broadcast_to(rack_hot, full)
+        ]
         ok &= (cnt2 - same_rack.astype(cnt2.dtype)) == 0
 
         # leadership eligibility when a leader slot changes brokers
-        ok &= (hs[:, :, None] != 0) | static.leadership_dst_ok[cold][:, None, None]
-        ok &= (cs[:, None, :] != 0) | static.leadership_dst_ok[hot][:, None, None]
+        ok &= (hs[:, None, :, None] != 0) | static.leadership_dst_ok[cold][None, :, None, None]
+        ok &= (cs[None, :, None, :] != 0) | static.leadership_dst_ok[hot][:, None, None, None]
 
         # capacity + potential NW_OUT must not get worse on either end
         # (CapacityGoal / PotentialNwOutGoal acceptance, conservative form)
-        h_load1 = _all_res_contrib(static, agg.assignment, hp, hs)  # [N, K, 4]
-        c_load2 = _all_res_contrib(static, agg.assignment, cp, cs)  # [N, K, 4]
-        hot_before = agg.broker_load[hot][:, None, None, :]
-        cold_before = agg.broker_load[cold][:, None, None, :]
-        hot_after = hot_before - h_load1[:, :, None, :] + c_load2[:, None, :, :]
-        cold_after = cold_before + h_load1[:, :, None, :] - c_load2[:, None, :, :]
-        hot_limit = jnp.maximum(static.capacity_limit[hot][:, None, None, :], hot_before)
-        cold_limit = jnp.maximum(static.capacity_limit[cold][:, None, None, :], cold_before)
+        h_load1 = _all_res_contrib(static, agg.assignment, hp, hs)  # [NH, K, 4]
+        c_load2 = _all_res_contrib(static, agg.assignment, cp, cs)  # [NC, K, 4]
+        net = h_load1[:, None, :, None, :] - c_load2[None, :, None, :, :]  # [NH,NC,K,K,4]
+        hot_before = agg.broker_load[hot][:, None, None, None, :]
+        cold_before = agg.broker_load[cold][None, :, None, None, :]
+        hot_after = hot_before - net
+        cold_after = cold_before + net
+        hot_limit = jnp.maximum(static.capacity_limit[hot][:, None, None, None, :], hot_before)
+        cold_limit = jnp.maximum(static.capacity_limit[cold][None, :, None, None, :], cold_before)
         ok &= jnp.all(hot_after <= hot_limit + 1e-6, axis=-1)
         ok &= jnp.all(cold_after <= cold_limit + 1e-6, axis=-1)
-        pnw1 = static.part_load[hp, PartMetric.NW_OUT_LEADER][:, :, None]
-        pnw2 = static.part_load[cp, PartMetric.NW_OUT_LEADER][:, None, :]
+        pnw1 = static.part_load[hp, PartMetric.NW_OUT_LEADER][:, None, :, None]
+        pnw2 = static.part_load[cp, PartMetric.NW_OUT_LEADER][None, :, None, :]
         pnw_limit = static.capacity_limit[:, Resource.NW_OUT]
-        cold_pnw_after = agg.potential_nw_out[cold][:, None, None] + pnw1 - pnw2
-        ok &= (cold_pnw_after <= jnp.maximum(pnw_limit[cold][:, None, None],
-                                             agg.potential_nw_out[cold][:, None, None]) + 1e-6)
-        hot_pnw_after = agg.potential_nw_out[hot][:, None, None] - pnw1 + pnw2
-        ok &= (hot_pnw_after <= jnp.maximum(pnw_limit[hot][:, None, None],
-                                            agg.potential_nw_out[hot][:, None, None]) + 1e-6)
+        pnw_cold0 = agg.potential_nw_out[cold][None, :, None, None]
+        pnw_hot0 = agg.potential_nw_out[hot][:, None, None, None]
+        ok &= pnw_cold0 + pnw1 - pnw2 <= jnp.maximum(
+            pnw_limit[cold][None, :, None, None], pnw_cold0
+        ) + 1e-6
+        ok &= pnw_hot0 - pnw1 + pnw2 <= jnp.maximum(
+            pnw_limit[hot][:, None, None, None], pnw_hot0
+        ) + 1e-6
 
-        # goal improvement: imbalance reduction of the (hot, cold) pair
-        u_h = util[hot][:, None, None]
-        u_c = util[cold][:, None, None]
-        d_h = delta / cap[hot][:, None, None]
-        d_c = delta / cap[cold][:, None, None]
-        before = _dist(u_h, gs) + _dist(u_c, gs)
-        after = _dist(u_h - d_h, gs) + _dist(u_c + d_c, gs)
-        score = jnp.where(ok & gs.active, before - after, -jnp.inf)
+        # goal improvement: imbalance reduction of the (hot, cold) pair; like
+        # the move path, NEITHER endpoint may get individually worse (the
+        # reference's swap search keeps both brokers within their limits —
+        # rebalanceBySwappingLoadOut only swaps toward in-range states)
+        u_h = util[hot][:, None, None, None]
+        u_c = util[cold][None, :, None, None]
+        d_h = delta / cap[hot][:, None, None, None]
+        d_c = delta / cap[cold][None, :, None, None]
+        h0, h1 = _dist(u_h, gs), _dist(u_h - d_h, gs)
+        c0, c1 = _dist(u_c, gs), _dist(u_c + d_c, gs)
+        endpoint_ok = (h1 <= h0 + SCORE_EPS) & (c1 <= c0 + SCORE_EPS)
+        score = jnp.where(ok & endpoint_ok & gs.active, h0 + c0 - h1 - c1, -jnp.inf)
 
-        # best swap per hot/cold pair, applied sequentially with re-validation
-        flat = score.reshape(n_pairs, k * k)
-        best = jnp.argmax(flat, axis=1)
-        best_score = jnp.take_along_axis(flat, best[:, None], axis=1)[:, 0]
-        a_idx = (best // k).astype(jnp.int32)
+        # top-J swaps per HOT broker (over all cold partners x replica pairs),
+        # applied sequentially with re-validation
+        n_sel = max(1, min(swaps_per_broker, n_pairs * k * k))
+        flat = score.reshape(n_pairs, n_pairs * k * k)
+        best_scores, best = jax.lax.top_k(flat, n_sel)  # [N, J]
+        j_idx = (best // (k * k)).astype(jnp.int32)
+        a_idx = ((best // k) % k).astype(jnp.int32)
         b_idx = (best % k).astype(jnp.int32)
-        rows = jnp.arange(n_pairs)
+        rows = jnp.arange(n_pairs)[:, None]
         sel = dict(
-            p1=hp[rows, a_idx], s1=hs[rows, a_idx],
-            p2=cp[rows, b_idx], s2=cs[rows, b_idx],
-            hot=hot, cold=cold, score=best_score,
+            p1=hp[rows, a_idx].reshape(-1), s1=hs[rows, a_idx].reshape(-1),
+            p2=cp[j_idx, b_idx].reshape(-1), s2=cs[j_idx, b_idx].reshape(-1),
+            hot=jnp.broadcast_to(hot[:, None], (n_pairs, n_sel)).reshape(-1),
+            cold=cold[j_idx].reshape(-1), score=best_scores.reshape(-1),
         )
 
         def body(carry, i):
@@ -204,11 +230,13 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
             u_h2 = agg_c.broker_load[h, res] / cap[h]
             u_c2 = agg_c.broker_load[c, res] / cap[c]
             d = contrib[p1, s1] - contrib[p2, s2]
-            improve = (
-                _dist(u_h2, gs) + _dist(u_c2, gs)
-                - _dist(u_h2 - d / cap[h], gs) - _dist(u_c2 + d / cap[c], gs)
+            h0r, h1r = _dist(u_h2, gs), _dist(u_h2 - d / cap[h], gs)
+            c0r, c1r = _dist(u_c2, gs), _dist(u_c2 + d / cap[c], gs)
+            improve = h0r + c0r - h1r - c1r
+            endpoint_ok2 = (h1r <= h0r + SCORE_EPS) & (c1r <= c0r + SCORE_EPS)
+            apply_flag = (
+                jnp.isfinite(sel["score"][i]) & still & endpoint_ok2 & (improve > SCORE_EPS)
             )
-            apply_flag = jnp.isfinite(sel["score"][i]) & still & (improve > SCORE_EPS)
             mv1 = build_selected(
                 static.part_load, agg_c.assignment, p1,
                 jnp.int32(KIND_MOVE), s1, c,
@@ -222,11 +250,125 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8):
             return (agg_c, any_applied | apply_flag), apply_flag
 
         (agg2, applied_any), _ = jax.lax.scan(
-            body, (agg, jnp.asarray(False)), jnp.arange(n_pairs)
+            body, (agg, jnp.asarray(False)), jnp.arange(n_pairs * n_sel)
         )
         return agg2, applied_any
 
     return swap_round
+
+
+def make_distribution_round(goal, dims, n_hot: int = 16, k_rep: int = 16,
+                            j_apply: int = 4, k_dst: int = 16):
+    """Move phase for resource-distribution goals: the array form of
+    rebalanceByMovingLoadOut/-In (cc/analyzer/goals/ResourceDistributionGoal.java
+    :364,:699) — per hot broker, drain its heaviest replicas toward the
+    coldest brokers; fill under-loaded brokers from the richest.
+
+    The reference's AbstractGoal pass applies MANY actions per broker while
+    walking brokersToBalance (rebalanceForBroker), so applying the top-J
+    moves per hot broker under sequential re-validation is structurally the
+    reference loop, vectorized. Unlike the optimizer's global [P, R, K] grid
+    + top-k shortlist — which picks the k best *partitions* against stale
+    state and degrades the reachable optimum as k grows — this kernel's cost
+    is independent of P (top_k pulls per-broker replica lists), so rounds are
+    cheap enough to keep near-greedy action quality at full scale.
+    """
+    res = goal.resource
+    p_count, r = dims.num_partitions, dims.max_rf
+    n_hot = max(1, min(n_hot, dims.num_brokers))
+    n_cold = n_hot
+    k_rep = max(1, min(k_rep, p_count))
+    use_leadership = goal.uses_leadership and r >= 2
+    j_lead = max(4, j_apply)
+
+    def dist_round(static: StaticCtx, agg: Aggregates, tables, gs):
+        cap = jnp.maximum(static.broker_capacity[:, res], 1e-9)
+        util = agg.broker_load[:, res] / cap
+
+        # dead brokers outrank every live one as sources: evacuation comes
+        # first (GoalUtils.ensureNoReplicaOnDeadBrokers), and score_batch's
+        # DEAD_EVACUATION_BONUS makes their moves win the selection
+        hot_rank = jnp.where(static.dead, jnp.inf, util)
+        _, hot = jax.lax.top_k(hot_rank, n_hot)  # i32[V] sources (richest)
+        cold_rank = jnp.where(static.alive & static.replica_dst_ok, -util, -jnp.inf)
+        cold_ok, cold = jax.lax.top_k(cold_rank, n_cold)  # i32[V] receivers
+
+        contrib = _slot_contrib(static, agg.assignment, res)
+        movable = static.movable_partition[:, None] & (agg.assignment >= 0)
+
+        def pick_heavy(broker):
+            mask = (agg.assignment == broker) & movable
+            score = jnp.where(mask, contrib, -jnp.inf)
+            vals, idx = jax.lax.top_k(score.reshape(p_count * r), k_rep)
+            return (idx // r).astype(jnp.int32), (idx % r).astype(jnp.int32)
+
+        hp, hs = jax.vmap(pick_heavy)(hot)  # [V, K]
+
+        # move grid [V, K, C]: replica k of hot_i -> cold_j
+        full = (n_hot, k_rep, n_cold)
+        mv = build_selected(
+            static.part_load, agg.assignment,
+            jnp.broadcast_to(hp[:, :, None], full),
+            jnp.int32(KIND_MOVE),
+            jnp.broadcast_to(hs[:, :, None], full),
+            jnp.broadcast_to(cold[None, None, :], full),
+        )
+        from cruise_control_tpu.analyzer.acceptance import score_batch
+
+        s = score_batch(static, agg, mv, goal, gs, tables)
+        s = jnp.where(jnp.isfinite(cold_ok)[None, None, :], s, -jnp.inf)
+
+        n_sel = max(1, min(j_apply, k_rep * n_cold))
+        flat = s.reshape(n_hot, k_rep * n_cold)
+        top_s, top_i = jax.lax.top_k(flat, n_sel)  # [V, J]
+        rows = jnp.arange(n_hot)[:, None]
+        a_idx = (top_i // n_cold).astype(jnp.int32)
+        c_idx = (top_i % n_cold).astype(jnp.int32)
+        sel_p = hp[rows, a_idx].reshape(-1)
+        sel_slot = hs[rows, a_idx].reshape(-1)
+        sel_dst = cold[c_idx].reshape(-1)
+        sel_kind = jnp.full(sel_p.shape, KIND_MOVE, dtype=jnp.int32)
+        sel_score = top_s.reshape(-1)
+
+        # leadership family (CPU / NW_OUT shift util without moving data):
+        # global [P, R-1] grid, top-J overall
+        if use_leadership:
+            from cruise_control_tpu.analyzer.actions import make_leadership_batch
+
+            lb = make_leadership_batch(static.part_load, agg.assignment)
+            sl = score_batch(static, agg, lb, goal, gs, tables)
+            sl = jnp.broadcast_to(sl, (p_count, r - 1)).reshape(p_count * (r - 1))
+            lead_s, lead_i = jax.lax.top_k(sl, j_lead)
+            sel_p = jnp.concatenate([sel_p, (lead_i // (r - 1)).astype(jnp.int32)])
+            sel_slot = jnp.concatenate(
+                [sel_slot, (lead_i % (r - 1)).astype(jnp.int32) + 1]
+            )
+            sel_dst = jnp.concatenate([sel_dst, jnp.zeros(j_lead, dtype=jnp.int32)])
+            sel_kind = jnp.concatenate(
+                [sel_kind, jnp.full((j_lead,), KIND_LEADERSHIP, dtype=jnp.int32)]
+            )
+            sel_score = jnp.concatenate([sel_score, lead_s])
+
+        def body(carry, i):
+            agg_c, applied_any = carry
+            p_i, slot_i, kind_i = sel_p[i], sel_slot[i], sel_kind[i]
+            dst_i = jnp.where(
+                kind_i == KIND_MOVE, sel_dst[i], agg_c.assignment[p_i, slot_i]
+            )
+            act = build_selected(
+                static.part_load, agg_c.assignment, p_i, kind_i, slot_i, dst_i
+            )
+            s_i = score_batch(static, agg_c, act, goal, gs, tables)
+            ok = jnp.isfinite(sel_score[i]) & jnp.isfinite(s_i)
+            agg_c = apply_action(static, agg_c, act, ok)
+            return (agg_c, applied_any | ok), ok
+
+        (agg2, applied_any), _ = jax.lax.scan(
+            body, (agg, jnp.asarray(False)), jnp.arange(sel_p.shape[0])
+        )
+        return agg2, applied_any
+
+    return dist_round
 
 
 def _dist(u, gs):

@@ -163,8 +163,9 @@ class ClusterProperty:
     replication_factor: int = 2
     #: mean broker utilization as a fraction of capacity, per resource
     mean_utilization: float = 0.35
-    #: 'uniform' | 'exponential' | 'linear' — mirrors the load distributions in
-    #: RandomCluster*NewBrokerTest
+    #: 'uniform' | 'exponential' | 'linear' | 'pareto' — mirrors the load
+    #: distributions in RandomCluster*NewBrokerTest; 'pareto' adds the
+    #: hot-partition regime (a handful of partitions dominate the cluster)
     load_distribution: str = "exponential"
     rack_aware_placement: bool = True
     num_dead_brokers: int = 0
@@ -217,6 +218,10 @@ def random_cluster(
         raw = rng.uniform(0.5, 1.5, size=(p, 4))
     elif prop.load_distribution == "linear":
         raw = np.linspace(0.1, 1.9, p)[:, None] * rng.uniform(0.8, 1.2, size=(p, 4))
+    elif prop.load_distribution == "pareto":
+        # heavy tail: the hottest ~1% of partitions carry a large share of
+        # the load (BASELINE config 3's hot-partition regime)
+        raw = rng.pareto(1.5, size=(p, 4)) + 0.05
     else:  # exponential: few hot partitions dominate
         raw = rng.exponential(1.0, size=(p, 4))
     raw = raw.astype(np.float32)
@@ -232,7 +237,15 @@ def random_cluster(
     cpu_weight = 1.0 + follower_cpu_ratio * (rf - 1)
     cpu_leader = raw[:, 0] / raw[:, 0].sum() * budget(Resource.CPU, cpu_weight)
     nw_in = raw[:, 1] / raw[:, 1].sum() * budget(Resource.NW_IN, float(rf))
-    nw_out = raw[:, 2] / raw[:, 2].sum() * budget(Resource.NW_OUT, 1.0)
+    # NW_OUT budget is sized against *potential* leadership (every replica
+    # counted, PotentialNwOutGoal semantics): leader-only utilization is then
+    # mean_utilization/rf and potential utilization is mean_utilization, below
+    # the capacity threshold — matching real clusters, where potential NW_OUT
+    # is a binding-but-satisfiable constraint. A leader-sized budget would put
+    # every broker's potential above the threshold, and a globally-violated
+    # PotentialNwOutGoal (faithfully to the reference's actionAcceptance)
+    # vetoes every replica move for all downstream goals.
+    nw_out = raw[:, 2] / raw[:, 2].sum() * budget(Resource.NW_OUT, float(rf))
     disk = raw[:, 3] / raw[:, 3].sum() * budget(Resource.DISK, float(rf))
     load = _part_load(cpu_leader, nw_in, nw_out, disk, follower_cpu_ratio=follower_cpu_ratio)
 
@@ -282,7 +295,7 @@ BASELINE_CONFIGS = {
                        mean_partitions_per_topic=20.0, replication_factor=3),
     3: ClusterProperty(num_racks=10, num_brokers=100, num_topics=500,
                        mean_partitions_per_topic=20.0, replication_factor=3,
-                       load_distribution="exponential", mean_utilization=0.5),
+                       load_distribution="pareto", mean_utilization=0.5),
     4: ClusterProperty(num_racks=10, num_brokers=100, num_topics=500,
                        mean_partitions_per_topic=20.0, replication_factor=3,
                        num_new_brokers=4),

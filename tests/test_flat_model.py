@@ -178,8 +178,15 @@ def test_random_cluster_mean_utilization(rf):
     loads = np.asarray(fm.broker_loads(m))
     cap = np.asarray(m.broker_capacity)
     mean_util = loads.sum(0) / cap.sum(0)
-    for res in (Resource.CPU, Resource.NW_OUT, Resource.DISK):
+    for res in (Resource.CPU, Resource.DISK):
         assert abs(mean_util[res] - 0.4) < 0.02, (res, mean_util)
+    # NW_OUT is budgeted against *potential* leadership (every replica counted)
+    # so PotentialNwOutGoal is binding but satisfiable; leader-only utilization
+    # is then target/rf.
+    assert abs(mean_util[Resource.NW_OUT] - 0.4 / rf) < 0.02, mean_util
+    from cruise_control_tpu.common.resources import PartMetric
+    potential = np.asarray(m.part_load)[:, PartMetric.NW_OUT_LEADER].sum() * rf
+    assert abs(potential / cap[:, Resource.NW_OUT].sum() - 0.4) < 0.02
 
 
 def test_random_cluster_more_racks_than_brokers():

@@ -108,6 +108,27 @@ class TestSelfHealing:
         )
         sanity_check(model._replace(assignment=final))
 
+    def test_distribution_goals_evacuate_dead_brokers(self):
+        """The drain/fill kernel must treat dead brokers as top-priority
+        sources (regression: a dead broker with low utilization never entered
+        the hot set, so a usage-goal-only stack left replicas on it)."""
+        prop = generators.ClusterProperty(
+            num_racks=4, num_brokers=12, num_topics=10,
+            mean_partitions_per_topic=6.0, replication_factor=2,
+            num_dead_brokers=2,
+        )
+        model = generators.random_cluster(seed=3, prop=prop)
+        result = GoalOptimizer().optimizations(
+            model,
+            ["DiskUsageDistributionGoal", "CpuUsageDistributionGoal"],
+            raise_on_hard_failure=False,
+        )
+        final = result.final_assignment
+        dead_ids = np.nonzero(np.asarray(model.broker_state) == 3)[0]
+        assert not np.isin(final[final >= 0], dead_ids).any(), (
+            "usage-distribution goals must evacuate dead brokers"
+        )
+
 
 class TestFullStack:
     @pytest.fixture(scope="class")

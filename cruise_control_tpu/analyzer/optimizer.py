@@ -521,6 +521,8 @@ class GoalOptimizer:
         invoked per goal in one burst AFTER the stack completes, with each
         goal's round-share of the measured stack wall-clock (an attribution,
         not a per-goal measurement; compile time is excluded)."""
+        from cruise_control_tpu.common.sensors import REGISTRY
+
         t0 = time.monotonic()
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
@@ -625,6 +627,9 @@ class GoalOptimizer:
             if pr.new_leader != pr.old_leader and not pr.replicas_to_add
         )
         data_mb = sum(pr.data_to_move_mb for pr in proposals)
+        wall = time.monotonic() - t0
+        REGISTRY.timer("GoalOptimizer.proposal-computation-timer").record(wall)
+        REGISTRY.timer("GoalOptimizer.stack-execution-timer").record(stack_s)
         return OptimizerResult(
             proposals=proposals,
             goal_results=goal_results,
@@ -634,5 +639,5 @@ class GoalOptimizer:
             num_replica_moves=n_moves,
             num_leadership_moves=n_leader,
             data_to_move_mb=float(data_mb),
-            duration_s=time.monotonic() - t0,
+            duration_s=wall,
         )

@@ -1,0 +1,108 @@
+"""Process-wide sensor registry: named timers and meters.
+
+The analog of the reference's Dropwizard MetricRegistry + JmxReporter under
+the `kafka.cruisecontrol` domain (cc/KafkaCruiseControlMain.java:67-69) and
+the sensor table in docs/wiki "User Guide/Sensors.md": well-known names like
+`GoalOptimizer.proposal-computation-timer` (cc/analyzer/GoalOptimizer.java
+:123) and `LoadMonitor.cluster-model-creation-timer` (cc/monitor/LoadMonitor
+.java:157). Instead of JMX, the registry snapshot is served through `/state`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict
+
+
+class Timer:
+    """Count + total/max/last seconds; use as a context manager."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self.last_s = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+            self.last_s = seconds
+
+    def __enter__(self) -> "Timer":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.record(time.monotonic() - self._t0)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            mean = self.total_s / self.count if self.count else 0.0
+            return {
+                "count": self.count,
+                "totalS": round(self.total_s, 6),
+                "meanS": round(mean, 6),
+                "maxS": round(self.max_s, 6),
+                "lastS": round(self.last_s, 6),
+            }
+
+
+class Meter:
+    """Monotonic event counter."""
+
+    def __init__(self) -> None:
+        self.count = 0
+        self._lock = threading.Lock()
+
+    def mark(self, n: int = 1) -> None:
+        with self._lock:
+            self.count += n
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {"count": self.count}
+
+
+class SensorRegistry:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._timers: Dict[str, Timer] = {}
+        self._meters: Dict[str, Meter] = {}
+        self._gauges: Dict[str, Callable[[], object]] = {}
+
+    def timer(self, name: str) -> Timer:
+        with self._lock:
+            return self._timers.setdefault(name, Timer())
+
+    def meter(self, name: str) -> Meter:
+        with self._lock:
+            return self._meters.setdefault(name, Meter())
+
+    def gauge(self, name: str, fn: Callable[[], object]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            timers = dict(self._timers)
+            meters = dict(self._meters)
+            gauges = dict(self._gauges)
+        out: Dict[str, object] = {}
+        for name, t in timers.items():
+            out[name] = t.snapshot()
+        for name, m in meters.items():
+            out[name] = m.snapshot()
+        for name, fn in gauges.items():
+            try:
+                out[name] = fn()
+            except Exception:
+                out[name] = None
+        return out
+
+
+#: the process-wide registry (the `kafka.cruisecontrol` JMX domain analog)
+REGISTRY = SensorRegistry()

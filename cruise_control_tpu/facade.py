@@ -331,15 +331,25 @@ class CruiseControl:
 
     def state(self) -> Dict:
         """Aggregated sub-states (/state endpoint; KafkaCruiseControl :1148)."""
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        monitor_state = {
+            "state": self._monitor.state,
+            "generation": self._monitor.generation,
+            "sensors": dict(self._monitor.sensors),
+        }
+        fetcher = getattr(self._monitor._sampler, "sensors", None)
+        if fetcher is not None:  # N-way MetricFetcherManager in place
+            monitor_state["fetchers"] = {
+                k: (list(v) if isinstance(v, list) else v) for k, v in fetcher.items()
+            }
         return {
-            "MonitorState": {
-                "state": self._monitor.state,
-                "generation": self._monitor.generation,
-                "sensors": dict(self._monitor.sensors),
-            },
+            "MonitorState": monitor_state,
             "ExecutorState": self._executor.state_summary(),
             "AnalyzerState": {
                 "goals": [g.name for g in DEFAULT_GOAL_ORDER],
                 "cachedProposals": self._cached is not None,
             },
+            # named timers/meters (Sensors.md; JMX domain kafka.cruisecontrol)
+            "Sensors": REGISTRY.snapshot(),
         }

@@ -110,6 +110,16 @@ def main(argv=None) -> int:
     parser.add_argument("--two-step-verification", action="store_true")
     args = parser.parse_args(argv)
 
+    # probe the default backend before anything touches JAX: a dead TPU
+    # tunnel must degrade to CPU instead of hanging startup (platform_probe)
+    from cruise_control_tpu.platform_probe import ensure_live_backend
+
+    ensure_live_backend()
+
+    from cruise_control_tpu.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
     from cruise_control_tpu.servlet.server import run_server
 
     app, parts = build_simulated_service(

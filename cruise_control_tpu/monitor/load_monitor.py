@@ -91,9 +91,16 @@ class LoadMonitor:
         self._pause_reason: Optional[str] = None
         self._model_semaphore = threading.Semaphore(1)
         self._lock = threading.RLock()
+        #: serializes exclusive modes (one bootstrap/training at a time)
+        self._task_lock = threading.Lock()
         self._last_sample_ms = 0
         # sensor counters (cluster-model-creation-timer analog)
         self.sensors: Dict[str, float] = {"model_creations": 0, "model_creation_time_s": 0.0}
+        #: trainable CPU-estimation model fed by train_range
+        #: (cc/model/LinearRegressionModelParameters.java:26 analog)
+        from cruise_control_tpu.models.model_utils import LinearRegressionModelParameters
+
+        self.lr_params = LinearRegressionModelParameters()
 
         topo = metadata_client.refresh_metadata()
         common_fns = [AGGREGATION_OF[d] for d in COMMON_METRIC_DEFS]
@@ -171,17 +178,100 @@ class LoadMonitor:
                 if not self._sampling_paused:
                     self._state = LoadMonitorState.RUNNING
 
-    def bootstrap(self, samples: Samples) -> int:
-        """Backfill historic samples (LoadMonitorTaskRunner.bootstrap :127)."""
+    def _restore_state(self) -> None:
+        """Leave an exclusive mode without clobbering an operator pause."""
         with self._lock:
-            self._state = LoadMonitorState.BOOTSTRAPPING
-        try:
-            topo = self._metadata.refresh_metadata()
-            self._ensure_universe(topo)
-            return self._add_samples(samples, persist=False)
-        finally:
+            self._state = (
+                LoadMonitorState.PAUSED
+                if self._sampling_paused
+                else LoadMonitorState.RUNNING
+            )
+
+    def bootstrap(self, samples: Samples) -> int:
+        """Backfill historic samples (LoadMonitorTaskRunner.bootstrap :127).
+
+        `_task_lock` serializes the exclusive modes: the reference refuses to
+        start a bootstrap/training while another is in progress (:127); this
+        is the single authoritative guard for every entry point (REST and
+        task runner both land here)."""
+        with self._task_lock:
             with self._lock:
-                self._state = LoadMonitorState.RUNNING
+                self._state = LoadMonitorState.BOOTSTRAPPING
+            try:
+                topo = self._metadata.refresh_metadata()
+                self._ensure_universe(topo)
+                return self._add_samples(samples, persist=False)
+            finally:
+                self._restore_state()
+
+    def bootstrap_range(self, start_ms: int, end_ms: Optional[int] = None) -> int:
+        """Time-range bootstrap (BootstrapTask :21, the RANGE/SINCE modes of
+        LoadMonitorTaskRunner.bootstrap :127-177): replay the sample store's
+        history inside [start_ms, end_ms) into the window aggregators. The
+        store is this deployment's durable history — the analog of seeking a
+        consumer back through the metrics topic."""
+        part, brok = self._store.load_samples()
+        hi = end_ms if end_ms is not None else int(self._clock() * 1000)
+        picked = Samples(
+            [s for s in part if start_ms <= s.time_ms < hi],
+            [s for s in brok if start_ms <= s.time_ms < hi],
+        )
+        return self.bootstrap(picked)
+
+    def _lr_observe(self, metrics) -> bool:
+        """Feed one broker-metric vector into the LR model; False if skipped."""
+        from cruise_control_tpu.monitor.metricdef import KafkaMetricDef
+
+        cpu = float(metrics[KafkaMetricDef.CPU_USAGE])
+        if cpu <= 0:
+            return False
+        self.lr_params.add_observation(
+            cpu / 100.0,
+            float(metrics[KafkaMetricDef.LEADER_BYTES_IN]),
+            float(metrics[KafkaMetricDef.LEADER_BYTES_OUT]),
+            float(metrics[KafkaMetricDef.REPLICATION_BYTES_IN_RATE]),
+        )
+        return True
+
+    def train_range(self, start_ms: int, end_ms: Optional[int] = None) -> Dict:
+        """Training mode (LoadMonitorTaskRunner.train :205 + TrainingTask/
+        TrainingFetcher): feed broker samples from the range into the
+        linear-regression CPU model (ModelParameters analog). Returns the fit
+        summary; coefficients stay on `self.lr_params` for the estimator."""
+        with self._task_lock:
+            with self._lock:
+                self._state = LoadMonitorState.TRAINING
+            try:
+                _, brok = self._store.load_samples()
+                hi = end_ms if end_ms is not None else int(self._clock() * 1000)
+                n = sum(
+                    self._lr_observe(s.metrics)
+                    for s in brok
+                    if start_ms <= s.time_ms < hi
+                )
+                if n == 0:
+                    # no durable history in range (e.g. Noop store): observe
+                    # the in-memory broker windows instead — the recent
+                    # history the TrainingFetcher would re-sample.
+                    try:
+                        vals = self._broker_agg.aggregate().values  # [B, W, M]
+                    except ValueError:
+                        vals = None
+                    if vals is not None:
+                        n = sum(
+                            self._lr_observe(vals[b, w])
+                            for b in range(vals.shape[0])
+                            for w in range(vals.shape[1])
+                        )
+                coef = self.lr_params.train()
+                return {
+                    "observations_added": int(n),
+                    "total_observations": self.lr_params.num_observations,
+                    "trained": coef is not None,
+                    "coefficients": None if coef is None else [float(c) for c in coef],
+                }
+            finally:
+                self._restore_state()
 
     def _ensure_universe(self, topo) -> None:
         if topo.num_partitions > self._partition_agg.num_entities:
@@ -308,6 +398,11 @@ class LoadMonitor:
         )
         self.sensors["model_creations"] += 1
         self.sensors["model_creation_time_s"] += self._clock() - t0
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        REGISTRY.timer("LoadMonitor.cluster-model-creation-timer").record(
+            self._clock() - t0
+        )
         return model, meta
 
     def broker_stats(self) -> Dict:

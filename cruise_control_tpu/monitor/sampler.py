@@ -25,9 +25,15 @@ class Samples:
 
 
 class MetricSampler:
-    """SPI: fetch one round of samples for (a shard of) the cluster."""
+    """SPI: fetch one round of samples for (a shard of) the cluster.
 
-    def get_samples(self, topology: ClusterTopology, start_ms: int, end_ms: int) -> Samples:
+    `partitions` (optional i32[...] dense partition indices) is the shard
+    assigned by the fetcher manager's partition assignor; None means the
+    whole cluster. Samplers that pull from a self-distributing source (e.g.
+    a consumer group over the metrics topic) may ignore it."""
+
+    def get_samples(self, topology: ClusterTopology, start_ms: int, end_ms: int,
+                    partitions=None) -> Samples:
         raise NotImplementedError
 
     def close(self) -> None:
@@ -35,7 +41,7 @@ class MetricSampler:
 
 
 class NoopSampler(MetricSampler):
-    def get_samples(self, topology, start_ms, end_ms) -> Samples:
+    def get_samples(self, topology, start_ms, end_ms, partitions=None) -> Samples:
         return Samples([], [])
 
 
@@ -54,7 +60,11 @@ class TransportMetricSampler(MetricSampler):
         #: being lost (publish can race the round boundary)
         self._carry: list = []
 
-    def get_samples(self, topology: ClusterTopology, start_ms: int, end_ms: int) -> Samples:
+    def get_samples(self, topology: ClusterTopology, start_ms: int, end_ms: int,
+                    partitions=None) -> Samples:
+        # `partitions` is ignored: transport consumers self-distribute records
+        # (the consumer-group semantics of the reference's default sampler),
+        # so post-poll filtering would drop other shards' records for good.
         raw = self._carry + self._transport.poll(self._max_records)
         in_range = [m for m in raw if start_ms <= m.time_ms < end_ms]
         self._carry = [m for m in raw if m.time_ms >= end_ms]

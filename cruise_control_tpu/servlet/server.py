@@ -231,46 +231,36 @@ class CruiseControlApp:
         return self._json(self._purgatory.review_board())
 
     async def bootstrap(self, request) -> web.Response:
-        """Replay the sample store into the aggregators (BootstrapTask analog)."""
+        """Replay the sample store into the aggregators (BootstrapTask analog).
+
+        `start`/`end` (epoch ms) select the RANGE / SINCE bootstrap modes of
+        LoadMonitorTaskRunner.bootstrap (:127-177); with neither, the whole
+        store history replays."""
         monitor = self._facade._monitor
-        part, brok = monitor._store.load_samples()
-        n = monitor.bootstrap(
-            __import__("cruise_control_tpu.monitor.sampler", fromlist=["Samples"]).Samples(part, brok)
-        )
+        start = request.query.get("start")
+        end = request.query.get("end")
+        if start is not None or end is not None:
+            n = monitor.bootstrap_range(
+                int(start) if start is not None else 0,
+                int(end) if end is not None else None,
+            )
+        else:
+            from cruise_control_tpu.monitor.sampler import Samples
+
+            part, brok = monitor._store.load_samples()
+            n = monitor.bootstrap(Samples(part, brok))
         return self._json({"bootstrappedSamples": n, "state": monitor.state})
 
     async def train(self, request) -> web.Response:
-        """Train the linear-regression CPU model from broker windows
-        (LoadMonitorTaskRunner.train analog)."""
-        from cruise_control_tpu.models import model_utils
-        from cruise_control_tpu.monitor.metricdef import KafkaMetricDef
-
+        """Train the linear-regression CPU model from the range's broker
+        samples (LoadMonitorTaskRunner.train :205). `start`/`end` epoch ms;
+        defaults to the whole store history."""
         monitor = self._facade._monitor
-        try:
-            agg = monitor._broker_agg.aggregate()
-        except ValueError as e:
-            return self._json({"errorMessage": str(e)}, status=503)
-        vals = agg.values  # [B, W, M]
-        params = model_utils.LinearRegressionModelParameters()
-        for b in range(vals.shape[0]):
-            for w in range(vals.shape[1]):
-                cpu = float(vals[b, w, KafkaMetricDef.CPU_USAGE])
-                if cpu <= 0:
-                    continue
-                params.add_observation(
-                    cpu / 100.0,
-                    float(vals[b, w, KafkaMetricDef.LEADER_BYTES_IN]),
-                    float(vals[b, w, KafkaMetricDef.LEADER_BYTES_OUT]),
-                    float(vals[b, w, KafkaMetricDef.REPLICATION_BYTES_IN_RATE]),
-                )
-        coef = params.train()
-        return self._json(
-            {
-                "trained": coef is not None,
-                "observations": params.num_observations,
-                "coefficients": None if coef is None else [float(c) for c in coef],
-            }
-        )
+        start = int(request.query.get("start", "0"))
+        end = request.query.get("end")
+        result = monitor.train_range(start, int(end) if end is not None else None)
+        result["state"] = monitor.state
+        return self._json(result)
 
     # -- POST endpoints --------------------------------------------------------
 

@@ -96,7 +96,12 @@ def client_for(server) -> CruiseControlClient:
 def test_state_and_load_endpoints(server):
     c = client_for(server)
     state = c.request("state")
-    assert {"MonitorState", "ExecutorState", "AnalyzerState", "AnomalyDetectorState"} <= set(state)
+    assert {"MonitorState", "ExecutorState", "AnalyzerState", "AnomalyDetectorState",
+            "Sensors"} <= set(state)
+    # the sensor registry surfaces named timers (Sensors.md analog) once the
+    # corresponding subsystem has run at least once
+    assert "LoadMonitor.cluster-model-creation-timer" in state["Sensors"] or state[
+        "Sensors"] == {}
     load = c.request("load")
     assert len(load["brokers"]) == 6
     pl = c.request("partition_load", {"resource": "NW_OUT", "entries": 5})
@@ -158,9 +163,12 @@ def test_topic_configuration_rf_change(server):
 def test_train_and_bootstrap(server):
     c = client_for(server)
     out = c.request("train")
-    assert out["observations"] > 0
+    assert out["observations_added"] > 0
+    assert out["state"] == "RUNNING"
     boot = c.request("bootstrap")
     assert "bootstrappedSamples" in boot
+    ranged = c.request("bootstrap", {"start": "0", "end": "1"})
+    assert ranged["bootstrappedSamples"] == 0  # empty range replays nothing
 
 
 def test_cli_main_and_errors(server, capsys):

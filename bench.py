@@ -66,7 +66,8 @@ def _settings(batched: bool):
     from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
 
     if batched:
-        return OptimizerSettings(batch_k=256, max_rounds_per_goal=128, num_dst_candidates=16,
+        rounds = int(os.environ.get("BENCH_BATCHED_ROUNDS", "128"))
+        return OptimizerSettings(batch_k=256, max_rounds_per_goal=rounds, num_dst_candidates=16,
                                  num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4)
     # faithful greedy: one action per round in the shortlist path
     # (AbstractGoal.maybeApplyBalancingAction); resource-distribution goals use
@@ -253,6 +254,10 @@ def main() -> None:
     args = parser.parse_args()
 
     log(f"bench.py starting: python {sys.version.split()[0]}, pid {os.getpid()}")
+    import logging
+
+    logging.basicConfig(stream=sys.stderr, level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "75"))
 
     from cruise_control_tpu.platform_probe import ensure_live_backend

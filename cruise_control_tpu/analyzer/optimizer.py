@@ -401,12 +401,27 @@ _BUILD_LOCK = threading.Lock()
 
 
 def _stack_executable(goal_names, dims, settings, mesh, static, agg):
+    import logging
+
+    log = logging.getLogger(__name__)
     key = (goal_names, dims, settings, mesh)
     with _BUILD_LOCK:
         ex = _COMPILED_STACKS.get(key)
         if ex is None:
+            t0 = time.monotonic()
+            log.info(
+                "compiling fused goal stack: %d goals, P=%d B=%d T=%d%s",
+                len(goal_names), dims.num_partitions, dims.num_brokers,
+                dims.num_topics, " (mesh)" if mesh is not None else "",
+            )
             step = _cached_stack_step(goal_names, dims, settings)
-            ex = step.lower(static, agg).compile()
+            lowered = step.lower(static, agg)
+            t1 = time.monotonic()
+            ex = lowered.compile()
+            log.info(
+                "stack compiled in %.1fs (trace/lower %.1fs, XLA %.1fs)",
+                time.monotonic() - t0, t1 - t0, time.monotonic() - t1,
+            )
             _COMPILED_STACKS[key] = ex
             while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
                 _COMPILED_STACKS.popitem(last=False)

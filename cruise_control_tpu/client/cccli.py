@@ -1,9 +1,11 @@
 """cccli: command-line client for the REST API.
 
 Analog of cruise-control-client (cruisecontrolclient/client/cccli.py +
-Endpoint.py/Responder.py, SURVEY.md §2i): one subcommand per endpoint, typed
-parameters, and User-Task-ID polling for long operations — stdlib
-urllib only, so the CLI works anywhere the service does."""
+Endpoint.py/Responder.py/Display.py, SURVEY.md §2i): one subcommand per
+endpoint, typed CCParameter validation client-side (client.endpoint), table
+rendering for the well-known payloads (client.display, `--json` for raw), and
+User-Task-ID polling for long operations — stdlib urllib only, so the CLI
+works anywhere the service does."""
 
 from __future__ import annotations
 
@@ -68,6 +70,8 @@ def build_parser() -> argparse.ArgumentParser:
                         help="server base URL")
     parser.add_argument("--no-wait", action="store_true",
                         help="do not poll async operations to completion")
+    parser.add_argument("--json", action="store_true", dest="raw_json",
+                        help="print raw JSON instead of tables")
     sub = parser.add_subparsers(dest="endpoint", required=True)
 
     def add(name, *flags):
@@ -84,8 +88,8 @@ def build_parser() -> argparse.ArgumentParser:
     add("kafka_cluster_state", ("--verbose", bools))
     add("user_tasks")
     add("review_board")
-    add("bootstrap")
-    add("train")
+    add("bootstrap", ("--start", {"type": int}), ("--end", {"type": int}))
+    add("train", ("--start", {"type": int}), ("--end", {"type": int}))
     add("rebalance", ("--goals", {}), ("--dryrun", {"default": "true"}),
         ("--skip-hard-goal-check", bools), ("--review-id", {}))
     add("add_broker", ("brokerid", {}), ("--dryrun", {"default": "true"}), ("--review-id", {}))
@@ -105,17 +109,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
+    from cruise_control_tpu.client.display import render
+    from cruise_control_tpu.client.endpoint import validate_params
+
     args = build_parser().parse_args(argv)
     params = {
         k: v
         for k, v in vars(args).items()
-        if k not in ("address", "endpoint", "no_wait") and v not in (None, False)
+        if k not in ("address", "endpoint", "no_wait", "raw_json")
+        and v not in (None, False)
     }
-    params = {k: ("true" if v is True else v) for k, v in params.items()}
+    params = {k: ("true" if v is True else str(v)) for k, v in params.items()}
+    try:
+        params = validate_params(args.endpoint, params)
+    except ValueError as e:
+        print(f"invalid parameter: {e}", file=sys.stderr)
+        return 2
     client = CruiseControlClient(args.address)
     out = client.request(args.endpoint, params, wait=not args.no_wait)
-    json.dump(out, sys.stdout, indent=2, default=str)
-    print()
+    if args.raw_json or not isinstance(out, dict):
+        json.dump(out, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        print(render(args.endpoint, out))
     return 0 if "errorMessage" not in out else 1
 
 

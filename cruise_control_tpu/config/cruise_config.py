@@ -117,13 +117,13 @@ def _config_def() -> ConfigDef:
     d.define("num.metric.fetchers", Type.INT, 1, at_least(1), Importance.LOW,
              "Parallel sampling fetchers; partitions are assigned across them.")
     d.define("metric.sampler.class", Type.CLASS,
-             "cruise_control_tpu.monitor.sampling.NoopSampler", None, Importance.MEDIUM,
+             "cruise_control_tpu.monitor.sampler.NoopSampler", None, Importance.MEDIUM,
              "MetricSampler implementation (pluggable).")
     d.define("sample.store.class", Type.CLASS,
-             "cruise_control_tpu.monitor.sampling.NoopSampleStore", None, Importance.MEDIUM,
+             "cruise_control_tpu.monitor.sample_store.NoopSampleStore", None, Importance.MEDIUM,
              "SampleStore implementation (pluggable); replayed on startup.")
     d.define("broker.capacity.config.resolver.class", Type.CLASS,
-             "cruise_control_tpu.config.capacity.BrokerCapacityConfigFileResolver", None, Importance.MEDIUM,
+             "cruise_control_tpu.monitor.metadata.BrokerCapacityConfigFileResolver", None, Importance.MEDIUM,
              "BrokerCapacityConfigResolver implementation.")
     d.define("capacity.config.file", Type.STRING, "config/capacity.json", None, Importance.MEDIUM,
              "JSON file of per-broker capacities for the file resolver.")
@@ -189,6 +189,70 @@ def _config_def() -> ConfigDef:
              "Max requests parked in the purgatory.")
     d.define("two.step.purgatory.retention.time.ms", Type.LONG, 1209600000, at_least(0), Importance.LOW,
              "Retention of reviewed requests in the purgatory.")
+    # --- remaining reference keys (KafkaCruiseControlConfig.java), same names
+    # and defaults so an operator's cruisecontrol.properties parses unchanged
+    d.define("self.healing.goals", Type.LIST, "", None, Importance.MEDIUM,
+             "Goals used for self-healing; empty = the anomaly-detection goals.")
+    d.define("intra.broker.goals", Type.LIST, "", None, Importance.LOW,
+             "Intra-broker (disk-to-disk) goals; empty = disabled.")
+    d.define("topics.excluded.from.partition.movement", Type.STRING, "", None, Importance.MEDIUM,
+             "Regex of topics whose replicas must never move.")
+    d.define("replica.movement.strategies", Type.LIST,
+             "cruise_control_tpu.executor.strategy.PostponeUrpReplicaMovementStrategy,"
+             "cruise_control_tpu.executor.strategy.PrioritizeLargeReplicaMovementStrategy,"
+             "cruise_control_tpu.executor.strategy.PrioritizeSmallReplicaMovementStrategy,"
+             "cruise_control_tpu.executor.strategy.BaseReplicaMovementStrategy",
+             None, Importance.LOW,
+             "Replica-movement strategies available for chaining.")
+    d.define("executor.notifier.class", Type.CLASS,
+             "cruise_control_tpu.executor.notifier.LoggingExecutorNotifier", None, Importance.LOW,
+             "ExecutorNotifier implementation.")
+    d.define("metric.sampler.partition.assignor.class", Type.CLASS,
+             "cruise_control_tpu.monitor.fetcher.DefaultMetricSamplerPartitionAssignor",
+             None, Importance.LOW,
+             "MetricSamplerPartitionAssignor implementation for the fetcher manager.")
+    d.define("network.client.provider.class", Type.CLASS,
+             "cruise_control_tpu.monitor.metadata.MetadataClient", None, Importance.LOW,
+             "Cluster-facing network client provider (host-side I/O).")
+    d.define("max.allowed.extrapolations.per.partition", Type.INT, 5, at_least(0), Importance.LOW,
+             "Partitions with more extrapolated windows than this are invalid.")
+    d.define("max.allowed.extrapolations.per.broker", Type.INT, 5, at_least(0), Importance.LOW,
+             "Brokers with more extrapolated windows than this are invalid.")
+    d.define("linear.regression.model.cpu.util.bucket.size", Type.INT, 5, between(1, 100), Importance.LOW,
+             "CPU-utilization bucket width (percent) for LR observation balancing.")
+    d.define("anomaly.detection.allow.capacity.estimation", Type.BOOLEAN, True, None, Importance.LOW,
+             "Allow estimated broker capacities during anomaly detection.")
+    d.define("goal.violation.exclude.recently.demoted.brokers", Type.BOOLEAN, True, None, Importance.LOW,
+             "Exclude recently demoted brokers from goal-violation leadership fixes.")
+    d.define("goal.violation.exclude.recently.removed.brokers", Type.BOOLEAN, True, None, Importance.LOW,
+             "Exclude recently removed brokers from goal-violation replica fixes.")
+    d.define("broker.failure.exclude.recently.demoted.brokers", Type.BOOLEAN, True, None, Importance.LOW,
+             "Exclude recently demoted brokers from broker-failure leadership fixes.")
+    d.define("broker.failure.exclude.recently.removed.brokers", Type.BOOLEAN, True, None, Importance.LOW,
+             "Exclude recently removed brokers from broker-failure replica fixes.")
+    d.define("num.cached.recent.anomaly.states", Type.INT, 10, at_least(1), Importance.LOW,
+             "Recent anomaly states kept per anomaly type for /state.")
+    d.define("demotion.history.retention.time.ms", Type.LONG, 1209600000, at_least(0), Importance.LOW,
+             "How long demotion history is kept (reference default 336h).")
+    d.define("removal.history.retention.time.ms", Type.LONG, 1209600000, at_least(0), Importance.LOW,
+             "How long removal history is kept (reference default 336h).")
+    d.define("max.cached.completed.kafka.monitor.user.tasks", Type.INT, 25, at_least(0), Importance.LOW,
+             "Completed monitor-type user tasks retained (per-type retention).")
+    d.define("max.cached.completed.kafka.admin.user.tasks", Type.INT, 25, at_least(0), Importance.LOW,
+             "Completed admin-type user tasks retained (per-type retention).")
+    d.define("webserver.http.cors.origin", Type.STRING, "*", None, Importance.LOW,
+             "CORS Access-Control-Allow-Origin value.")
+    d.define("webserver.http.cors.allowmethods", Type.STRING, "OPTIONS, GET, POST", None, Importance.LOW,
+             "CORS Access-Control-Allow-Methods value.")
+    d.define("webserver.http.cors.exposeheaders", Type.STRING, "User-Task-ID", None, Importance.LOW,
+             "CORS Access-Control-Expose-Headers value.")
+    d.define("failed.brokers.zk.path", Type.STRING, "/CruiseControlBrokerList", None, Importance.LOW,
+             "Reference-compat alias of failed.brokers.file.path for ZK deployments.")
+    d.define("zookeeper.connect", Type.STRING, "", None, Importance.LOW,
+             "Reference-compat: ZK quorum of the managed cluster (unused by the "
+             "simulator driver; a ZK-backed ClusterDriver reads it).")
+    d.define("zookeeper.security.enabled", Type.BOOLEAN, False, None, Importance.LOW,
+             "Reference-compat: secure ZK for the managed cluster.")
     # --- TPU execution
     d.define("tpu.mesh.axis.name", Type.STRING, "shard", None, Importance.LOW,
              "Mesh axis name candidate/partition arrays are sharded over.")

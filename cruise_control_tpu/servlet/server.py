@@ -94,10 +94,12 @@ class CruiseControlApp:
         session_key = request.headers.get("X-Session") or request.remote or ""
         try:
             tid, future = self._tasks.get_or_create_task(
-                endpoint, factory, user_task_id, session_key + ":" + endpoint
+                endpoint, factory, user_task_id, session_key
             )
         except KeyError as e:
             return self._json({"errorMessage": str(e)}, status=404)
+        except RuntimeError as e:  # task/session capacity (nothing launched)
+            return self._json({"errorMessage": str(e)}, status=429)
         deadline = asyncio.get_event_loop().time() + self._wait_s
         while not future.done() and asyncio.get_event_loop().time() < deadline:
             await asyncio.sleep(0.02)
@@ -110,9 +112,37 @@ class CruiseControlApp:
         if exc is not None:
             status = 400 if isinstance(exc, IllegalRequestException) else 500
             return self._json({"errorMessage": str(exc)}, status=status, headers=headers)
-        return self._json(self._render_result(future.result()), headers=headers)
+        payload = await asyncio.to_thread(self._render_result, future.result())
+        return self._json(payload, headers=headers)
 
     def _render_result(self, result) -> Dict:
+        if hasattr(result, "goal_results"):  # an OptimizerResult
+            # rendering rebuilds the cluster model for the before/after load
+            # sections — memoize on the result so repeat polls of a finished
+            # task reuse it (always called off the event loop, see _async_op)
+            cached = getattr(result, "_rendered_response", None)
+            if cached is not None:
+                return cached
+            from cruise_control_tpu.servlet.responses import (
+                broker_stats_response,
+                optimization_result_response,
+            )
+
+            load_before = load_after = None
+            try:
+                model, meta = self._facade._monitor.cluster_model()
+                load_before = broker_stats_response(model, meta)
+                load_after = broker_stats_response(
+                    model._replace(assignment=result.final_assignment), meta
+                )
+            except Exception:
+                pass  # load sections are best-effort (windows may be gone)
+            payload = optimization_result_response(result, load_before, load_after)
+            try:
+                result._rendered_response = payload
+            except AttributeError:
+                pass
+            return payload
         if hasattr(result, "summary"):
             out = result.summary()
             out["proposals"] = [p.to_dict() for p in result.proposals[:10_000]]
@@ -147,10 +177,18 @@ class CruiseControlApp:
         return self._json(out)
 
     async def load(self, request) -> web.Response:
+        from cruise_control_tpu.monitor.completeness import (
+            ModelCompletenessRequirements,
+        )
+        from cruise_control_tpu.servlet.responses import broker_stats_response
+
         try:
-            return self._json(self._facade._monitor.broker_stats())
+            model, meta = self._facade._monitor.cluster_model(
+                ModelCompletenessRequirements(0, 0.0, False)
+            )
         except ValueError as e:
             return self._json({"errorMessage": str(e)}, status=503)
+        return self._json(broker_stats_response(model, meta).to_dict())
 
     async def partition_load(self, request) -> web.Response:
         resource = request.query.get("resource", "DISK").upper()
@@ -172,17 +210,21 @@ class CruiseControlApp:
         n = min(int(request.query.get("entries", "100")), col.shape[0])
         order = np.argsort(-col)[:n]
         a = np.asarray(model.assignment)
+        # PartitionLoadState.java record shape: topic/partition/leader/followers
         return self._json(
             {
                 "records": [
                     {
+                        "topic": meta.topic_names[int(model.topic_id[p])],
+                        "partition": int(meta.partition_index[p]),
                         "topicPartition": meta.topic_partition(int(p)),
                         "leader": int(a[p, 0]),
                         "followers": [int(b) for b in a[p, 1:] if b >= 0],
                         resource: float(col[p]),
                     }
                     for p in order
-                ]
+                ],
+                "version": 1,
             }
         )
 
@@ -223,7 +265,7 @@ class CruiseControlApp:
         return self._json(out)
 
     async def user_tasks(self, request) -> web.Response:
-        return self._json({"userTasks": self._tasks.describe_all()})
+        return self._json({"userTasks": self._tasks.describe_all(), "version": 1})
 
     async def review_board(self, request) -> web.Response:
         if self._purgatory is None:

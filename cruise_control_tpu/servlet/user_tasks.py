@@ -11,9 +11,91 @@ from __future__ import annotations
 import threading
 import time
 import uuid as uuid_mod
+import weakref
 from typing import Callable, Dict, List, Optional, Tuple
 
 from cruise_control_tpu.async_ops import OperationFuture
+
+
+class SessionManager:
+    """Session-reuse layer (cc/servlet/SessionManager.java, 309 LoC): binds a
+    client's session (X-Session header or remote address) + endpoint to its
+    in-flight request's task id, so a polling client re-attaches without
+    echoing the User-Task-ID. Sessions expire after `session_expiry_s` of no
+    touch and total concurrent sessions are capped; the active count is a
+    gauge in the sensor registry (`SessionManager.active-sessions`)."""
+
+    #: all live managers (weak): the registry gauge reports their sum, so
+    #: multiple apps in one process don't clobber each other's count and the
+    #: registry never pins a closed manager alive
+    _instances: "weakref.WeakSet" = None  # initialized below
+
+    def __init__(
+        self,
+        max_sessions: int = 100,
+        session_expiry_s: float = 300.0,
+        clock: Callable[[], float] = time.time,
+    ):
+        self._max = max_sessions
+        self._expiry_s = session_expiry_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        #: (session, endpoint) -> {"task": id, "touched": ts}
+        self._sessions: Dict[Tuple[str, str], Dict] = {}
+        SessionManager._instances.add(self)
+
+    def active_sessions(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def _expire(self) -> None:
+        now = self._clock()
+        for key in [
+            k for k, s in self._sessions.items() if now - s["touched"] > self._expiry_s
+        ]:
+            del self._sessions[key]
+
+    def task_for(self, session_key: str, endpoint: str) -> Optional[str]:
+        with self._lock:
+            self._expire()
+            entry = self._sessions.get((session_key, endpoint))
+            if entry is None:
+                return None
+            entry["touched"] = self._clock()
+            return entry["task"]
+
+    def check_capacity(self, session_key: str, endpoint: str) -> None:
+        """Raises RuntimeError when a NEW session cannot be created
+        (SessionManager.createSession's too-many-sessions guard). Called
+        BEFORE the operation is launched so a rejected request starts no
+        work."""
+        with self._lock:
+            self._expire()
+            key = (session_key, endpoint)
+            if key not in self._sessions and len(self._sessions) >= self._max:
+                raise RuntimeError("too many active sessions")
+
+    def bind(self, session_key: str, endpoint: str, task_id: str) -> None:
+        with self._lock:
+            self._expire()
+            self._sessions[(session_key, endpoint)] = {
+                "task": task_id, "touched": self._clock()
+            }
+
+    def unbind_task(self, task_id: str) -> None:
+        with self._lock:
+            for key in [k for k, s in self._sessions.items() if s["task"] == task_id]:
+                del self._sessions[key]
+
+
+SessionManager._instances = weakref.WeakSet()
+
+from cruise_control_tpu.common.sensors import REGISTRY as _REGISTRY  # noqa: E402
+
+_REGISTRY.gauge(
+    "SessionManager.active-sessions",
+    lambda: sum(m.active_sessions() for m in SessionManager._instances),
+)
 
 
 class UserTaskManager:
@@ -24,6 +106,7 @@ class UserTaskManager:
         max_retained_tasks: int = 500,
         clock: Callable[[], float] = time.time,
         uuid_factory: Callable[[], str] = lambda: str(uuid_mod.uuid4()),
+        session_manager: Optional[SessionManager] = None,
     ):
         self._max_active = max_active_tasks
         self._retention_s = completed_retention_s
@@ -32,7 +115,7 @@ class UserTaskManager:
         self._uuid = uuid_factory
         self._lock = threading.Lock()
         self._tasks: Dict[str, Dict] = {}  # id -> {future, endpoint, created, session}
-        self._by_session: Dict[Tuple[str, str], str] = {}  # (session, endpoint) -> id
+        self._sessions = session_manager or SessionManager(clock=clock)
 
     def _gc(self) -> None:
         now = self._clock()
@@ -53,7 +136,7 @@ class UserTaskManager:
     def _drop(self, tid: str) -> None:
         t = self._tasks.pop(tid, None)
         if t and t.get("session"):
-            self._by_session.pop((t["session"], t["endpoint"]), None)
+            self._sessions.unbind_task(tid)
 
     def get_or_create_task(
         self,
@@ -72,7 +155,7 @@ class UserTaskManager:
                     raise KeyError(f"unknown User-Task-ID {user_task_id}")
                 return user_task_id, t["future"]
             if session_key:
-                tid = self._by_session.get((session_key, endpoint))
+                tid = self._sessions.task_for(session_key, endpoint)
                 # session reuse only attaches to an IN-FLIGHT request (its
                 # purpose is polling); a finished task must be fetched by
                 # explicit User-Task-ID, else a new request with different
@@ -82,6 +165,10 @@ class UserTaskManager:
             active = sum(1 for t in self._tasks.values() if not t["future"].done())
             if active >= self._max_active:
                 raise RuntimeError("too many active user tasks")
+            if session_key:
+                # capacity check BEFORE launching: a rejected request must
+                # start no work
+                self._sessions.check_capacity(session_key, endpoint)
             tid = self._uuid()
             future = factory()
             self._tasks[tid] = {
@@ -91,7 +178,7 @@ class UserTaskManager:
                 "session": session_key,
             }
             if session_key:
-                self._by_session[(session_key, endpoint)] = tid
+                self._sessions.bind(session_key, endpoint, tid)
             return tid, future
 
     def get(self, user_task_id: str) -> Optional[OperationFuture]:
@@ -100,6 +187,8 @@ class UserTaskManager:
             return t["future"] if t else None
 
     def describe_all(self) -> List[Dict]:
+        """UserTaskState.java field names (UserTaskId/RequestURL/Status/
+        StartMs/ClientIdentity)."""
         with self._lock:
             self._gc()
             return [
@@ -108,6 +197,7 @@ class UserTaskManager:
                     "RequestURL": t["endpoint"],
                     "Status": "Completed" if t["future"].done() else "Active",
                     "StartMs": int(t["created"] * 1000),
+                    "ClientIdentity": t.get("session") or "",
                 }
                 for tid, t in self._tasks.items()
             ]

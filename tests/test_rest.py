@@ -95,15 +95,17 @@ def client_for(server) -> CruiseControlClient:
 
 def test_state_and_load_endpoints(server):
     c = client_for(server)
+    load = c.request("load")  # builds a model -> populates the model timer
+    assert len(load["brokers"]) == 6
+    assert {"Host", "Broker", "BrokerState", "DiskMB", "DiskPct", "CpuPct",
+            "LeaderNwInRate", "FollowerNwInRate", "NwOutRate", "PnwOutRate",
+            "Replicas", "Leaders"} <= set(load["brokers"][0])
+    assert load["version"] == 1 and "hosts" in load
     state = c.request("state")
     assert {"MonitorState", "ExecutorState", "AnalyzerState", "AnomalyDetectorState",
             "Sensors"} <= set(state)
-    # the sensor registry surfaces named timers (Sensors.md analog) once the
-    # corresponding subsystem has run at least once
-    assert "LoadMonitor.cluster-model-creation-timer" in state["Sensors"] or state[
-        "Sensors"] == {}
-    load = c.request("load")
-    assert len(load["brokers"]) == 6
+    # the sensor registry surfaces named timers (Sensors.md analog)
+    assert "LoadMonitor.cluster-model-creation-timer" in state["Sensors"]
     pl = c.request("partition_load", {"resource": "NW_OUT", "entries": 5})
     assert len(pl["records"]) == 5
     assert "topicPartition" in pl["records"][0]
@@ -119,7 +121,7 @@ def test_kafka_cluster_state(server):
 def test_proposals_and_user_task_flow(server):
     c = client_for(server)
     out = c.request("proposals")  # polls 202 -> 200 via User-Task-ID
-    assert "goals" in out and "proposals" in out
+    assert "goalSummary" in out and "proposals" in out and "summary" in out
     tasks = c.request("user_tasks")["userTasks"]
     assert any(t["RequestURL"] == "proposals" for t in tasks)
 
@@ -129,9 +131,15 @@ def test_rebalance_dryrun_and_execute(server):
     before = np.asarray(server["sim"].model().assignment).copy()
     dry = c.request("rebalance", {"dryrun": "true"})
     assert np.array_equal(before, np.asarray(server["sim"].model().assignment))
-    assert "numReplicaMovements" in dry
+    # OptimizationResult.java wire format: summary + goalSummary + proposals
+    assert "numReplicaMovements" in dry["summary"]
+    assert dry["version"] == 1
+    assert {g["status"] for g in dry["goalSummary"]} <= {"VIOLATED", "FIXED", "NO-ACTION"}
+    assert {"Host", "Broker", "BrokerState", "DiskMB", "CpuPct"} <= set(
+        dry["loadBeforeOptimization"]["brokers"][0]
+    )
     out = c.request("rebalance", {"dryrun": "false", "ignore_proposal_cache": "true"})
-    assert "numReplicaMovements" in out
+    assert "numReplicaMovements" in out["summary"]
 
 
 def test_sampling_pause_resume_and_admin(server):
@@ -210,6 +218,29 @@ def test_user_task_manager_semantics():
     assert all(t["UserTaskId"] != t1 for t in mgr.describe_all())
 
 
+def test_session_manager_capacity_checked_before_launch():
+    from cruise_control_tpu.servlet.user_tasks import SessionManager
+
+    now = {"t": 0.0}
+    sessions = SessionManager(max_sessions=2, session_expiry_s=50.0, clock=lambda: now["t"])
+    launched = []
+
+    def make():
+        launched.append(1)
+        return OperationFuture("op")
+
+    mgr = UserTaskManager(clock=lambda: now["t"], session_manager=sessions)
+    mgr.get_or_create_task("proposals", make, session_key="c1")
+    mgr.get_or_create_task("proposals", make, session_key="c2")
+    with pytest.raises(RuntimeError, match="sessions"):
+        mgr.get_or_create_task("proposals", make, session_key="c3")
+    assert len(launched) == 2, "a rejected request must start no work"
+    # expiry frees capacity
+    now["t"] = 100.0
+    mgr.get_or_create_task("proposals", make, session_key="c3")
+    assert len(launched) == 3
+
+
 def test_purgatory_two_step_flow():
     purgatory = Purgatory()
     rid = purgatory.add_request("rebalance", {"dryrun": "false"})
@@ -257,7 +288,7 @@ def test_two_step_verification_gate(server):
         rid = parked["reviewId"]
         c.request("review", {"approve": str(rid)})
         out = c.request("rebalance", {"dryrun": "true", "review_id": str(rid)})
-        assert "numReplicaMovements" in out
+        assert "numReplicaMovements" in out["summary"]
         # a second submit with the same review id is rejected
         again = c.request("rebalance", {"dryrun": "true", "review_id": str(rid)})
         assert "errorMessage" in again

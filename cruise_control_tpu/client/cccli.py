@@ -117,7 +117,9 @@ def main(argv=None) -> int:
         k: v
         for k, v in vars(args).items()
         if k not in ("address", "endpoint", "no_wait", "raw_json")
-        and v not in (None, False)
+        # `is` comparisons: 0 is a legitimate value (e.g. --start 0) and
+        # compares equal to False under `in`
+        and v is not None and v is not False
     }
     params = {k: ("true" if v is True else str(v)) for k, v in params.items()}
     try:

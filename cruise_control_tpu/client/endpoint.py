@@ -76,6 +76,22 @@ class SetOfChoicesParameter(CCParameter):
         return ",".join(parts)
 
 
+class SingleChoiceParameter(CCParameter):
+    """Exactly one value from a choice set, canonicalized to upper case."""
+
+    def __init__(self, name: str, choices: Sequence[str], doc: str = ""):
+        super().__init__(name, doc)
+        self.choices = {c.upper() for c in choices}
+
+    def validate(self, value: str) -> str:
+        v = str(value).strip().upper()
+        if v not in self.choices:
+            raise ValueError(
+                f"{self.name}: invalid value {value!r}; choices: {sorted(self.choices)}"
+            )
+        return v
+
+
 class CSVIntListParameter(CCParameter):
     def validate(self, value: str) -> str:
         try:
@@ -87,13 +103,14 @@ class CSVIntListParameter(CCParameter):
         return ",".join(str(i) for i in ids)
 
 
-_RESOURCES = ("CPU", "NW_IN", "NW_OUT", "DISK", "cpu", "nw_in", "nw_out", "disk")
+_RESOURCES = ("CPU", "NW_IN", "NW_OUT", "DISK")
 _ANOMALY_TYPES = ("goal_violation", "broker_failure", "metric_anomaly")
 
 #: endpoint -> {wire parameter name: CCParameter}
 ENDPOINT_PARAMETERS: Dict[str, Dict[str, CCParameter]] = {
     "partition_load": {
-        "resource": SetOfChoicesParameter("resource", _RESOURCES),
+        # the server resolves ONE Resource per request
+        "resource": SingleChoiceParameter("resource", _RESOURCES),
         "entries": NonNegativeIntegerParameter("entries"),
     },
     "proposals": {

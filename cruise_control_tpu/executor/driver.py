@@ -96,3 +96,91 @@ class SimulatorClusterDriver(ClusterDriver):
     def has_ongoing_reassignment(self) -> bool:
         with self._lock:
             return bool(self._pending)
+
+
+class ReassignmentJournalDriver(ClusterDriver):
+    """File-journal driver: the direct analog of the reference's Scala shim
+    writing reassignment JSON for the Kafka controller to act on
+    (scala/executor/ExecutorUtils.scala:32 writes
+    /admin/reassign_partitions; controller performs the movement and deletes
+    the node).
+
+    `journal_dir/reassign_partitions.json` holds the in-flight reassignment
+    in the controller wire format
+    ({"version": 1, "partitions": [{"topic", "partition", "replicas"}]});
+    an external controller-side agent applies it and writes per-task acks
+    into `journal_dir/completed/<execution_id>.json`. `poll()` merges new
+    tasks into the journal (the reference merges with in-progress
+    reassignments) and `is_finished` checks the ack file — the same
+    write-then-watch contract as the ZK node, over a shared filesystem."""
+
+    def __init__(self, journal_dir: str):
+        import os
+
+        self._dir = journal_dir
+        self._completed_dir = os.path.join(journal_dir, "completed")
+        os.makedirs(self._completed_dir, exist_ok=True)
+        self._journal = os.path.join(journal_dir, "reassign_partitions.json")
+        self._lock = threading.Lock()
+
+    def _read_journal(self) -> List[Dict]:
+        import json
+        import os
+
+        if not os.path.exists(self._journal):
+            return []
+        try:
+            with open(self._journal) as f:
+                return json.load(f).get("partitions", [])
+        except (OSError, ValueError):
+            return []
+
+    def _write_journal(self, partitions: List[Dict]) -> None:
+        import json
+        import os
+
+        tmp = self._journal + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"version": 1, "partitions": partitions}, f)
+        os.replace(tmp, self._journal)  # atomic, like a ZK setData
+
+    def _entry(self, task: ExecutionTask) -> Dict:
+        p = task.proposal
+        topic, _, part = (p.topic_partition or f"p-{p.partition}").rpartition("-")
+        return {
+            "topic": topic or f"p{p.partition}",
+            "partition": int(part) if part.isdigit() else p.partition,
+            "replicas": list(p.new_replicas),
+            "executionId": task.execution_id,
+        }
+
+    def start_replica_movement(self, task: ExecutionTask) -> None:
+        with self._lock:
+            entries = self._read_journal()
+            # merge with in-progress reassignments (ExecutorUtils :32 merges
+            # into the existing znode content rather than replacing it)
+            entries = [
+                e for e in entries if e.get("executionId") != task.execution_id
+            ] + [self._entry(task)]
+            self._write_journal(entries)
+
+    def start_leadership_movement(self, task: ExecutionTask) -> None:
+        self.start_replica_movement(task)
+
+    def is_finished(self, task: ExecutionTask) -> bool:
+        import os
+
+        ack = os.path.join(self._completed_dir, f"{task.execution_id}.json")
+        if not os.path.exists(ack):
+            return False
+        with self._lock:
+            remaining = [
+                e
+                for e in self._read_journal()
+                if e.get("executionId") != task.execution_id
+            ]
+            self._write_journal(remaining)
+        return True
+
+    def has_ongoing_reassignment(self) -> bool:
+        return bool(self._read_journal())

@@ -405,26 +405,3 @@ class LoadMonitor:
         )
         return model, meta
 
-    def broker_stats(self) -> Dict:
-        """Per-broker load summary for /load (LoadMonitor.cachedBrokerLoadStats)."""
-        from cruise_control_tpu.models.flat_model import broker_loads, leader_counts, replica_counts
-
-        model, meta = self.cluster_model(ModelCompletenessRequirements(0, 0.0, False))
-        loads = np.asarray(broker_loads(model))
-        reps = np.asarray(replica_counts(model))
-        lead = np.asarray(leader_counts(model))
-        return {
-            "brokers": [
-                {
-                    "Broker": int(meta.broker_ids[i]),
-                    "BrokerState": BrokerState(int(model.broker_state[i])).name,
-                    "CpuPct": float(loads[i, 0]),
-                    "NwInRate": float(loads[i, 1]),
-                    "NwOutRate": float(loads[i, 2]),
-                    "DiskMB": float(loads[i, 3]),
-                    "Replicas": int(reps[i]),
-                    "Leaders": int(lead[i]),
-                }
-                for i in range(model.num_brokers)
-            ]
-        }

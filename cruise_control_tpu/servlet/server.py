@@ -182,13 +182,19 @@ class CruiseControlApp:
         )
         from cruise_control_tpu.servlet.responses import broker_stats_response
 
-        try:
+        def build():
             model, meta = self._facade._monitor.cluster_model(
                 ModelCompletenessRequirements(0, 0.0, False)
             )
+            return broker_stats_response(model, meta).to_dict()
+
+        try:
+            # off the event loop: model build + per-broker rendering is heavy
+            # at scale and must not stall concurrent requests
+            payload = await asyncio.to_thread(build)
         except ValueError as e:
             return self._json({"errorMessage": str(e)}, status=503)
-        return self._json(broker_stats_response(model, meta).to_dict())
+        return self._json(payload)
 
     async def partition_load(self, request) -> web.Response:
         resource = request.query.get("resource", "DISK").upper()

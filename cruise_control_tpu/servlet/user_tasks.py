@@ -29,6 +29,7 @@ class SessionManager:
     #: multiple apps in one process don't clobber each other's count and the
     #: registry never pins a closed manager alive
     _instances: "weakref.WeakSet" = None  # initialized below
+    _instances_lock = threading.Lock()
 
     def __init__(
         self,
@@ -42,7 +43,15 @@ class SessionManager:
         self._lock = threading.Lock()
         #: (session, endpoint) -> {"task": id, "touched": ts}
         self._sessions: Dict[Tuple[str, str], Dict] = {}
-        SessionManager._instances.add(self)
+        #: probe for "is this task still running?" (wired by UserTaskManager):
+        #: idle expiry must never drop the binding of an in-flight task, or a
+        #: reconnecting client would duplicate a long optimization
+        self._task_alive: Callable[[str], bool] = lambda tid: False
+        with SessionManager._instances_lock:
+            SessionManager._instances.add(self)
+
+    def set_task_alive_probe(self, probe: Callable[[str], bool]) -> None:
+        self._task_alive = probe
 
     def active_sessions(self) -> int:
         with self._lock:
@@ -51,7 +60,9 @@ class SessionManager:
     def _expire(self) -> None:
         now = self._clock()
         for key in [
-            k for k, s in self._sessions.items() if now - s["touched"] > self._expiry_s
+            k
+            for k, s in self._sessions.items()
+            if now - s["touched"] > self._expiry_s and not self._task_alive(s["task"])
         ]:
             del self._sessions[key]
 
@@ -92,10 +103,13 @@ SessionManager._instances = weakref.WeakSet()
 
 from cruise_control_tpu.common.sensors import REGISTRY as _REGISTRY  # noqa: E402
 
-_REGISTRY.gauge(
-    "SessionManager.active-sessions",
-    lambda: sum(m.active_sessions() for m in SessionManager._instances),
-)
+def _active_sessions_total() -> int:
+    with SessionManager._instances_lock:  # snapshot: WeakSet mutates on ctor/GC
+        managers = list(SessionManager._instances)
+    return sum(m.active_sessions() for m in managers)
+
+
+_REGISTRY.gauge("SessionManager.active-sessions", _active_sessions_total)
 
 
 class UserTaskManager:
@@ -116,6 +130,9 @@ class UserTaskManager:
         self._lock = threading.Lock()
         self._tasks: Dict[str, Dict] = {}  # id -> {future, endpoint, created, session}
         self._sessions = session_manager or SessionManager(clock=clock)
+        self._sessions.set_task_alive_probe(
+            lambda tid: tid in self._tasks and not self._tasks[tid]["future"].done()
+        )
 
     def _gc(self) -> None:
         now = self._clock()

@@ -165,3 +165,52 @@ def test_full_loop_optimizer_to_executor_converges():
     for p in range(final.shape[0]):
         assert set(final[p][final[p] >= 0]) == set(want[p][want[p] >= 0]), p
         assert final[p, 0] == want[p, 0], p
+
+
+def test_reassignment_journal_driver(tmp_path):
+    """The ZK-shim analog: reassignment JSON written for an external
+    controller agent, completion acked via files (write-then-watch)."""
+    import json
+    import os
+    import threading
+    import time
+
+    from cruise_control_tpu.executor.driver import ReassignmentJournalDriver
+
+    journal_dir = str(tmp_path / "journal")
+    driver = ReassignmentJournalDriver(journal_dir)
+    props = [
+        ExecutionProposal(partition=0, old_replicas=(0, 1), new_replicas=(2, 1),
+                          topic_partition="topic-0"),
+        ExecutionProposal(partition=2, old_replicas=(0, 2), new_replicas=(2, 0),
+                          topic_partition="topic-2"),
+    ]
+
+    # a controller-side agent: applies whatever appears in the journal
+    stop = threading.Event()
+
+    def controller_agent():
+        while not stop.wait(0.02):
+            path = os.path.join(journal_dir, "reassign_partitions.json")
+            if not os.path.exists(path):
+                continue
+            try:
+                with open(path) as f:
+                    entries = json.load(f)["partitions"]
+            except (OSError, ValueError):
+                continue
+            for e in entries:
+                ack = os.path.join(journal_dir, "completed", f"{e['executionId']}.json")
+                with open(ack, "w") as f:
+                    json.dump({"done": True}, f)
+
+    th = threading.Thread(target=controller_agent, daemon=True)
+    th.start()
+    try:
+        execu = Executor(driver, config=ExecutorConfig(execution_progress_check_interval_s=0.02))
+        result = execu.execute_proposals(props)
+        assert result["numFinishedMovements"] == 2
+        assert not driver.has_ongoing_reassignment(), "journal must be drained"
+    finally:
+        stop.set()
+        th.join(timeout=2)

@@ -230,13 +230,19 @@ def test_session_manager_capacity_checked_before_launch():
         return OperationFuture("op")
 
     mgr = UserTaskManager(clock=lambda: now["t"], session_manager=sessions)
-    mgr.get_or_create_task("proposals", make, session_key="c1")
-    mgr.get_or_create_task("proposals", make, session_key="c2")
+    _, f1 = mgr.get_or_create_task("proposals", make, session_key="c1")
+    _, f2 = mgr.get_or_create_task("proposals", make, session_key="c2")
     with pytest.raises(RuntimeError, match="sessions"):
         mgr.get_or_create_task("proposals", make, session_key="c3")
     assert len(launched) == 2, "a rejected request must start no work"
-    # expiry frees capacity
+    # in-flight bindings survive idle expiry (a reconnecting client must
+    # re-attach, not duplicate a long optimization)
     now["t"] = 100.0
+    with pytest.raises(RuntimeError, match="sessions"):
+        mgr.get_or_create_task("proposals", make, session_key="c3")
+    # once the tasks complete, expiry frees capacity
+    f1.set_result(1)
+    f2.set_result(1)
     mgr.get_or_create_task("proposals", make, session_key="c3")
     assert len(launched) == 3
 

@@ -65,17 +65,23 @@ TARGET_S = 10.0  # config-5 north star (BASELINE.md)
 def _settings(batched: bool):
     from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
 
+    # chunked goal machine: bounds each device call's duration so the remote
+    # TPU transport never kills a long-running fused call (the config-5
+    # failure mode); 0 restores the single fused-stack call
+    chunk = int(os.environ.get("BENCH_CHUNK_ROUNDS", "16"))
     if batched:
         rounds = int(os.environ.get("BENCH_BATCHED_ROUNDS", "128"))
         return OptimizerSettings(batch_k=256, max_rounds_per_goal=rounds, num_dst_candidates=16,
-                                 num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4)
+                                 num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
+                                 chunk_rounds=chunk)
     # faithful greedy: one action per round in the shortlist path
     # (AbstractGoal.maybeApplyBalancingAction); resource-distribution goals use
     # the same reference-shaped per-broker drain/fill kernel in both modes but
     # run here to deeper convergence (4x the rounds), making the greedy
     # reference a STRICTLY stronger baseline on those goals.
     return OptimizerSettings(batch_k=1, max_rounds_per_goal=512, num_dst_candidates=16,
-                             num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4)
+                             num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
+                             chunk_rounds=chunk * 4 if chunk else 0)
 
 
 def _goal_table(result):
@@ -87,6 +93,7 @@ def _goal_table(result):
             "costBefore": round(g.cost_before, 6),
             "costAfter": round(g.cost_after, 6),
             "rounds": g.rounds,
+            "durationS": round(g.duration_s, 4),
         }
         for g in result.goal_results
     ]

@@ -324,6 +324,146 @@ def apply_action(static: StaticCtx, agg: Aggregates, act: ActionBatch, apply_fla
     )
 
 
+def wave_select(score, src, dst, dst_host, valid, num_brokers: int, num_hosts: int,
+                dst_host2=None, parts=(), num_partitions: int = 0):
+    """bool[N]: a conflict-free, score-prioritized subset of candidate actions.
+
+    Contract: among selected entries, every broker appears in at most ONE
+    action (either endpoint), every destination HOST receives at most one
+    action, and — when `parts` carries the entries' partition ids — every
+    PARTITION appears in at most one action. Under that disjointness a wave
+    of individually-validated actions composes exactly like sequential
+    application (no shared aggregate is touched twice, no per-partition rack
+    count is double-spent), including the host-level CPU capacity check —
+    this is what lets the optimizer apply a whole shortlist in O(waves)
+    sequential steps instead of O(batch_k).
+
+    `parts` is a tuple of i32[N] arrays (a swap touches two partitions, so it
+    passes both); callers whose candidate sets are per-partition by
+    construction (the optimizer's top-k-over-partitions shortlist) may omit
+    it. Selection: an entry survives iff it holds the max score on BOTH its
+    brokers (ties broken by lowest index), then at most one survivor per
+    destination host and per partition. Chains (A beats B on a shared broker,
+    B beats C) can under-select; later waves retry the losers against updated
+    state.
+    """
+    n = score.shape[0]
+    s = jnp.where(valid, score, -jnp.inf)
+    src_c = jnp.where(valid, src, num_brokers)
+    dst_c = jnp.where(valid, dst, num_brokers)
+    gmax = jnp.full((num_brokers + 1,), -jnp.inf).at[src_c].max(s).at[dst_c].max(s)
+    cand = valid & (s >= gmax[src_c]) & (s >= gmax[dst_c])
+    idx = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.int32(n + 1)
+    idx_c = jnp.where(cand, idx, big)
+    imin = jnp.full((num_brokers + 1,), big).at[src_c].min(idx_c).at[dst_c].min(idx_c)
+    sel = cand & (idx == imin[src_c]) & (idx == imin[dst_c])
+    def unique_per_group(sel, claim_arrays, n_groups):
+        """Keep, per group id, only the lowest-index selected entry — over the
+        UNION of the claim arrays (an entry must win every group it claims, so
+        A's first claim conflicts with B's second)."""
+        claims = [jnp.where(sel, c, n_groups) for c in claim_arrays]
+        idx_s = jnp.where(sel, idx, big)
+        cmin = jnp.full((n_groups + 1,), big)
+        for c in claims:
+            cmin = cmin.at[c].min(idx_s)
+        for c in claims:
+            sel = sel & (idx == cmin[c])
+        return sel
+
+    # at most one action lands per destination host per wave (swaps load both
+    # ends, so they pass both endpoint hosts)
+    hosts = [h for h in (dst_host, dst_host2) if h is not None]
+    if hosts:
+        sel = unique_per_group(sel, hosts, num_hosts)
+    # at most one action per partition per wave: two replicas of the same
+    # partition moving in one wave would each pass a rack check that is
+    # jointly wrong (both landing on the same rack) and would race their
+    # assignment-row writes
+    if parts:
+        sel = unique_per_group(sel, list(parts), num_partitions)
+    return sel
+
+
+def apply_actions_batch(
+    static: StaticCtx, agg: Aggregates, act: ActionBatch, flags: jax.Array
+) -> Aggregates:
+    """Apply a WAVE of actions (1-D fields in `act`, `flags: bool[N]`) at once.
+
+    Correct when the flagged actions are pairwise conflict-free — distinct
+    partitions and distinct src/dst brokers (wave_select's contract, above):
+    applying them together then equals applying them
+    sequentially in any order, with each individually valid at its turn —
+    i.e. a batch of reference-legal greedy steps, not an approximation.
+    Scatter-adds are duplicate-safe regardless; only the per-action
+    *validation* relies on disjointness.
+    """
+    p_total = agg.assignment.shape[0]
+    is_move = act.kind == KIND_MOVE
+    p, slot, src, dst = act.p, act.slot, act.src, act.dst
+    w = flags
+    a = agg.assignment
+
+    # (p, slot) receives: dst for moves, the old leader for leadership swaps;
+    # (p, 0) additionally receives the old slot-holder for leadership swaps.
+    # Masked-out writes are routed out of bounds and dropped, so a move into
+    # slot 0 never races a leadership write to the same element.
+    old_leader = a[p, 0]
+    old_holder = a[p, slot]
+    val_slot = jnp.where(is_move, dst, old_leader)
+    p_any = jnp.where(w, p, p_total)
+    p_lead = jnp.where(w & ~is_move, p, p_total)
+    new_assignment = a.at[p_any, slot].set(val_slot, mode="drop")
+    new_assignment = new_assignment.at[p_lead, jnp.zeros_like(slot)].set(
+        old_holder, mode="drop"
+    )
+
+    wf = jnp.where(w, 1.0, 0.0)
+    dload = act.dload * wf[..., None]
+    broker_load = agg.broker_load.at[src].add(-dload).at[dst].add(dload)
+
+    dint = jnp.where(w, 1, 0)
+    drep = act.drep * dint
+    replica_count = agg.replica_count.at[src].add(-drep).at[dst].add(drep)
+    dlead = act.dleader * dint
+    leader_count = agg.leader_count.at[src].add(-dlead).at[dst].add(dlead)
+
+    dpnw = act.dpnw * wf
+    potential = agg.potential_nw_out.at[src].add(-dpnw).at[dst].add(dpnw)
+    dlnw = act.dleader_nw_in * wf
+    leader_nw_in = agg.leader_nw_in.at[src].add(-dlnw).at[dst].add(dlnw)
+
+    dmove = jnp.where(w & is_move, 1, 0)
+    rack_src = static.broker_rack[src]
+    rack_dst = static.broker_rack[dst]
+    rack_counts = (
+        agg.rack_replica_count.at[p, rack_src].add(-dmove).at[p, rack_dst].add(dmove)
+    )
+    topic = static.topic_id[p]
+    topic_counts = (
+        agg.topic_replica_count.at[topic, src].add(-dmove).at[topic, dst].add(dmove)
+    )
+
+    dcpu = dload[..., Resource.CPU]
+    host_cpu = (
+        agg.host_cpu_load.at[static.broker_host[src]]
+        .add(-dcpu)
+        .at[static.broker_host[dst]]
+        .add(dcpu)
+    )
+    return Aggregates(
+        assignment=new_assignment,
+        broker_load=broker_load,
+        replica_count=replica_count,
+        leader_count=leader_count,
+        potential_nw_out=potential,
+        leader_nw_in=leader_nw_in,
+        rack_replica_count=rack_counts,
+        topic_replica_count=topic_counts,
+        host_cpu_load=host_cpu,
+    )
+
+
 def utilization(agg: Aggregates, static: StaticCtx) -> jax.Array:
     """f32[B, 4] load / capacity."""
     return agg.broker_load / jnp.maximum(static.broker_capacity, 1e-9)

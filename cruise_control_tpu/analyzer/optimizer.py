@@ -61,10 +61,12 @@ from cruise_control_tpu.analyzer.context import (
     OptimizationOptions,
     StaticCtx,
     apply_action,
+    apply_actions_batch,
     build_static_ctx,
     compute_aggregates,
     dims_of,
     dst_hosts_partition,
+    wave_select,
 )
 from cruise_control_tpu.analyzer.acceptance import (
     empty_tables,
@@ -109,6 +111,20 @@ class OptimizerSettings:
     #: (partition/topic create/delete) reuses compiled goal steps instead of
     #: recompiling; broker churn still recompiles (rare in practice)
     bucket_partitions: bool = True
+    #: > 0: execute via the chunked goal machine — many short device calls of
+    #: at most this many rounds each — instead of the single fused-stack call.
+    #: Same kernels, same results; bounds each device call's duration, which
+    #: remote-TPU transports require at north-star scale (a single call
+    #: covering the full 2,600-broker stack runs for minutes and gets killed
+    #: by the tunnel's RPC deadline). 0 = single fused call.
+    chunk_rounds: int = 0
+    #: conflict-free apply waves per round: shortlisted actions are applied in
+    #: at most this many parallel waves (distinct src/dst brokers per wave)
+    #: instead of one long sequential re-validated scan — the sequential depth
+    #: per round drops from batch_k to apply_waves with identical legality
+    #: (each applied action is valid at application time; see
+    #: context.apply_actions_batch)
+    apply_waves: int = 8
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -118,6 +134,8 @@ class OptimizerSettings:
             num_dst_candidates=config.get_int("optimizer.candidate.replicas.per.broker"),
             num_swap_pairs=config.get_int("optimizer.swap.broker.pairs"),
             swap_candidates=config.get_int("optimizer.swap.candidate.replicas"),
+            chunk_rounds=config.get_int("optimizer.chunk.rounds"),
+            apply_waves=config.get_int("optimizer.apply.waves"),
         )
 
 
@@ -158,7 +176,9 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
 
 
 # concrete-action materialization lives in actions.build_selected (shared
-# with the swap kernel)
+# with the swap kernel); wave selection + batched apply live in context
+# (wave_select / apply_actions_batch, shared with the swap/distribution
+# kernels)
 
 
 def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
@@ -212,61 +232,70 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
 
         # ---- global top-k shortlist over partitions
         top_scores, top_p = jax.lax.top_k(best_score, k_sel)
-        sel = build_selected(
-            static.part_load,
-            agg.assignment,
-            top_p.astype(jnp.int32),
-            best_kind[top_p],
-            best_slot[top_p],
-            best_dst[top_p],
-        )
+        sel_p = top_p.astype(jnp.int32)
+        sel_kind = best_kind[top_p]
+        sel_slot = best_slot[top_p]
+        sel_dst0 = best_dst[top_p]
+        n_waves = max(1, min(settings.apply_waves, k_sel))
 
-        # ---- sequential re-validated apply
-        def body(carry, i):
-            agg_c, applied_any = carry
-            act = jax.tree_util.tree_map(lambda f: f[i], sel)
-            gs_c = gs  # thresholds stay fixed within a round (initGoalState)
+        # ---- conflict-free apply waves: each wave re-validates every not-yet
+        # -applied shortlist entry against the CURRENT aggregates (including
+        # re-choosing each move's destination — applying many stale-dst
+        # actions piles load onto the brokers that looked best at round
+        # start), then applies a broker-disjoint, score-prioritized subset at
+        # once. Sequential depth per round: apply_waves, not batch_k.
+        def wave(carry, _):
+            agg_c, applied_any, done = carry
             if goal.uses_moves:
-                # Re-choose the destination under the CURRENT aggregates: the
-                # shortlist's dst was the argmax against round-start state, and
-                # applying many stale-dst actions piles load onto the brokers
-                # that looked best at round start — a worse local optimum than
-                # the reference greedy, which re-argmaxes after every action.
-                # The original dst rides along as the last candidate so the
-                # re-choice can never lose an action the shortlist had.
-                cands = jnp.concatenate([dst_cands, act.dst[None]])
-                nk = cands.shape[0]
-                is_move = act.kind == KIND_MOVE
+                # the original dst rides along as the last candidate so the
+                # re-choice can never lose an action the shortlist had
+                cands = jnp.concatenate(
+                    [jnp.broadcast_to(dst_cands[None, :], (k_sel, kk)), sel_dst0[:, None]],
+                    axis=1,
+                )  # [k_sel, kk+1]
+                nk = kk + 1
                 candK = build_selected(
                     static.part_load,
                     agg_c.assignment,
-                    jnp.broadcast_to(act.p, (nk,)),
-                    jnp.broadcast_to(act.kind, (nk,)),
-                    jnp.broadcast_to(act.slot, (nk,)),
+                    jnp.broadcast_to(sel_p[:, None], (k_sel, nk)),
+                    jnp.broadcast_to(sel_kind[:, None], (k_sel, nk)),
+                    jnp.broadcast_to(sel_slot[:, None], (k_sel, nk)),
                     cands,
                 )
-                s_k = score_batch(static, agg_c, candK, goal, gs_c, tables)
-                best_dst = cands[jnp.argmax(s_k)]
+                s_k = score_batch(static, agg_c, candK, goal, gs, tables)
+                j = jnp.argmax(s_k, axis=1)
+                best_dst_now = jnp.take_along_axis(cands, j[:, None], axis=1)[:, 0]
                 # leadership "dst" is wherever slot's replica lives NOW
                 fresh_dst = jnp.where(
-                    is_move, best_dst, agg_c.assignment[act.p, act.slot]
+                    sel_kind == KIND_MOVE, best_dst_now,
+                    agg_c.assignment[sel_p, sel_slot],
                 )
-                act = build_selected(
-                    static.part_load, agg_c.assignment, act.p, act.kind,
-                    act.slot, fresh_dst,
+            else:
+                fresh_dst = jnp.where(
+                    sel_kind == KIND_MOVE, sel_dst0, agg_c.assignment[sel_p, sel_slot]
                 )
+            act = build_selected(
+                static.part_load, agg_c.assignment, sel_p, sel_kind, sel_slot, fresh_dst
+            )
             mask = structural_mask(static, agg_c, act)
             mask = mask & tables_acceptance(static, tables, agg_c, act)
-            mask = mask & goal.acceptance(static, gs_c, agg_c, act)
-            score = goal.action_score(static, gs_c, agg_c, act)
+            mask = mask & goal.acceptance(static, gs, agg_c, act)
+            score = goal.action_score(static, gs, agg_c, act)
             evac = static.dead[act.src] & ((act.kind == KIND_MOVE) | (act.dleader > 0))
             score = score + jnp.where(evac, DEAD_EVACUATION_BONUS, 0.0)
-            apply_flag = mask & (score > SCORE_EPS) & jnp.isfinite(top_scores[i])
-            agg_c = apply_action(static, agg_c, act, apply_flag)
-            return (agg_c, applied_any | apply_flag), apply_flag
+            ok = mask & (score > SCORE_EPS) & jnp.isfinite(top_scores) & ~done
+            w_sel = wave_select(
+                score, act.src, act.dst, static.broker_host[act.dst], ok,
+                dims.num_brokers, dims.num_hosts,
+            )
+            agg_c = apply_actions_batch(static, agg_c, act, w_sel)
+            return (agg_c, applied_any | jnp.any(w_sel), done | w_sel), None
 
-        (agg2, applied_any), _ = jax.lax.scan(
-            body, (agg, jnp.asarray(False)), jnp.arange(k_sel)
+        (agg2, applied_any, _), _ = jax.lax.scan(
+            wave,
+            (agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)),
+            None,
+            length=n_waves,
         )
         return agg2, applied_any
 
@@ -301,14 +330,22 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             k_rep=max(16, settings.swap_candidates),
             j_apply=settings.swaps_per_broker,
             k_dst=k_dst,
+            apply_waves=settings.apply_waves,
         )
 
-    def goal_loop(static: StaticCtx, agg: Aggregates, tables):
+    def goal_loop(static: StaticCtx, agg: Aggregates, tables, budget=None):
+        """Run rounds until convergence or `budget` rounds (dynamic scalar;
+        defaults to the static per-goal cap). Returns (agg, rounds, stalled):
+        `stalled` means the goal converged — the last round applied nothing —
+        as opposed to merely running out of budget (the chunked executor's
+        resume signal)."""
         gs0 = goal.prepare(static, agg, dims)
+        if budget is None:
+            budget = jnp.int32(settings.max_rounds_per_goal)
 
         def cond(c):
             _, rnd, done = c
-            return (rnd < settings.max_rounds_per_goal) & ~done
+            return (rnd < budget) & ~done
 
         def body(c):
             agg_c, rnd, _ = c
@@ -328,10 +365,10 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 applied = applied | swap_applied
             return (agg2, rnd + 1, ~applied)
 
-        final_agg, rounds, _ = jax.lax.while_loop(
+        final_agg, rounds, stalled = jax.lax.while_loop(
             cond, body, (agg, jnp.int32(0), jnp.asarray(False))
         )
-        return final_agg, rounds
+        return final_agg, rounds, stalled
 
     return goal_loop
 
@@ -372,7 +409,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             gs0 = goal.prepare(static, agg, dims)
             vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
             cb.append(goal.cost(static, gs0, agg).astype(jnp.float32))
-            agg, rounds = loop(static, agg, tables)
+            agg, rounds, _ = loop(static, agg, tables)
             gs1 = goal.prepare(static, agg, dims)
             va.append(jnp.sum(goal.broker_violation(static, gs1, agg)).astype(jnp.int32))
             ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
@@ -396,6 +433,59 @@ def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     return _make_stack_step(goal_names, dims, settings)
 
 
+def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+    """Bounded-duration executor: ONE jitted program that runs ONE goal
+    (dynamic `goal_idx` via lax.switch) for at most `budget` rounds.
+
+    The fused stack (_make_stack_step) executes the whole priority loop as a
+    single device call; at north-star scale (2,600 brokers / 200k partitions)
+    that call runs for minutes, longer than remote-TPU transports tolerate.
+    This machine carries the same state — aggregates + merged acceptance
+    tables — across many short calls instead: the host sequences goals and
+    round chunks, each call bounded by `budget` rounds, with identical
+    semantics (goal thresholds are derived from move-invariant totals, so
+    recomputing them per chunk equals the reference's one initGoalState per
+    goal.optimize, AbstractGoal.java:67).
+
+    Returns machine(static, agg, tables, goal_idx, budget) ->
+      (agg2, tables2, rounds, stalled, viol_in, cost_in, viol_out, cost_out)
+    where tables2 already includes this goal's contribution — the host uses
+    tables2 once it deems the goal complete (stalled, or per-goal round cap
+    reached) and keeps tables otherwise. Compile cost matches the fused
+    stack: all goal bodies are traced once into the one switch program.
+    """
+    from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
+
+    goals = [GOAL_REGISTRY[n] for n in goal_names]
+    loops = [_make_goal_loop(g, dims, settings) for g in goals]
+
+    def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx, budget):
+        def make_branch(goal, loop):
+            def branch(operands):
+                static_b, agg_b, tables_b, budget_b = operands
+                gs_in = goal.prepare(static_b, agg_b, dims)
+                viol_in = jnp.sum(goal.broker_violation(static_b, gs_in, agg_b)).astype(jnp.int32)
+                cost_in = goal.cost(static_b, gs_in, agg_b).astype(jnp.float32)
+                agg2, rounds, stalled = loop(static_b, agg_b, tables_b, budget_b)
+                gs_out = goal.prepare(static_b, agg2, dims)
+                viol_out = jnp.sum(goal.broker_violation(static_b, gs_out, agg2)).astype(jnp.int32)
+                cost_out = goal.cost(static_b, gs_out, agg2).astype(jnp.float32)
+                tables2 = goal.contribute_acceptance(static_b, gs_out, tables_b)
+                return agg2, tables2, rounds, stalled, viol_in, cost_in, viol_out, cost_out
+
+            return branch
+
+        branches = [make_branch(g, l) for g, l in zip(goals, loops)]
+        return jax.lax.switch(goal_idx, branches, (static, agg, tables, budget))
+
+    return jax.jit(machine)
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+    return _make_goal_machine(goal_names, dims, settings)
+
+
 #: AOT-compiled stack executables, keyed on (goal stack, dims, settings,
 #: mesh), built under one lock so concurrent optimizations() calls never
 #: duplicate a stack compile (lru_cache alone does not coalesce in-flight
@@ -408,27 +498,24 @@ _COMPILED_STACKS_MAX = 16
 _BUILD_LOCK = threading.Lock()
 
 
-def _stack_executable(goal_names, dims, settings, mesh, static, agg):
+def _compile_cached(key, tag, dims, build):
     import logging
 
     log = logging.getLogger(__name__)
-    key = (goal_names, dims, settings, mesh)
     with _BUILD_LOCK:
         ex = _COMPILED_STACKS.get(key)
         if ex is None:
             t0 = time.monotonic()
             log.info(
-                "compiling fused goal stack: %d goals, P=%d B=%d T=%d%s",
-                len(goal_names), dims.num_partitions, dims.num_brokers,
-                dims.num_topics, " (mesh)" if mesh is not None else "",
+                "compiling %s: P=%d B=%d T=%d",
+                tag, dims.num_partitions, dims.num_brokers, dims.num_topics,
             )
-            step = _cached_stack_step(goal_names, dims, settings)
-            lowered = step.lower(static, agg)
+            lowered = build()
             t1 = time.monotonic()
             ex = lowered.compile()
             log.info(
-                "stack compiled in %.1fs (trace/lower %.1fs, XLA %.1fs)",
-                time.monotonic() - t0, t1 - t0, time.monotonic() - t1,
+                "%s compiled in %.1fs (trace/lower %.1fs, XLA %.1fs)",
+                tag, time.monotonic() - t0, t1 - t0, time.monotonic() - t1,
             )
             _COMPILED_STACKS[key] = ex
             while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
@@ -436,6 +523,32 @@ def _stack_executable(goal_names, dims, settings, mesh, static, agg):
         else:
             _COMPILED_STACKS.move_to_end(key)
     return ex
+
+
+def _stack_executable(goal_names, dims, settings, mesh, static, agg):
+    key = ("stack", goal_names, dims, settings, mesh)
+    tag = (
+        f"fused goal stack ({len(goal_names)} goals"
+        + (", mesh)" if mesh is not None else ")")
+    )
+    return _compile_cached(
+        key, tag, dims,
+        lambda: _cached_stack_step(goal_names, dims, settings).lower(static, agg),
+    )
+
+
+def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
+    key = ("machine", goal_names, dims, settings, mesh)
+    tag = (
+        f"chunked goal machine ({len(goal_names)} goals"
+        + (", mesh)" if mesh is not None else ")")
+    )
+    return _compile_cached(
+        key, tag, dims,
+        lambda: _cached_goal_machine(goal_names, dims, settings).lower(
+            static, agg, tables, jnp.int32(0), jnp.int32(1)
+        ),
+    )
 
 
 # -- results -------------------------------------------------------------------
@@ -526,6 +639,61 @@ class GoalOptimizer:
         self._settings = settings
         self._mesh = mesh
 
+    def _run_chunked(self, goal_names: Tuple[str, ...], dims: Dims, static, agg):
+        """Drive the goal machine: sequence goals on the host, each executed
+        as chunks of at most `chunk_rounds` rounds per device call.
+
+        Exactly one host sync per chunk (the rounds/stalled/stats read);
+        a 715-round north-star run at chunk 16 costs ~45 syncs, microseconds
+        each — while no single device call can outlive the transport."""
+        from cruise_control_tpu.analyzer.acceptance import empty_tables as _empty
+
+        tables = _empty(dims)
+        if self._mesh is not None:
+            from cruise_control_tpu.parallel.sharding import place_replicated
+
+            tables = place_replicated(tables, self._mesh)
+        machine = _machine_executable(
+            goal_names, dims, self._settings, self._mesh, static, agg, tables
+        )
+        n = len(goal_names)
+        vb = np.zeros(n, np.int32)
+        va = np.zeros(n, np.int32)
+        cb = np.zeros(n, np.float32)
+        ca = np.zeros(n, np.float32)
+        rs = np.zeros(n, np.int32)
+        durs = np.zeros(n, np.float64)
+        cap = self._settings.max_rounds_per_goal
+        chunk = self._settings.chunk_rounds
+        t_stack = time.monotonic()
+        for i in range(n):
+            t_goal = time.monotonic()
+            total = 0
+            first = True
+            while True:
+                budget = min(chunk, cap - total)
+                agg, tables2, rounds, stalled, vi, ci, vo, co = machine(
+                    static, agg, tables, jnp.int32(i), jnp.int32(max(1, budget))
+                )
+                rounds_h, stalled_h, vi_h, ci_h, vo_h, co_h = jax.device_get(
+                    (rounds, stalled, vi, ci, vo, co)
+                )
+                if first:
+                    vb[i], cb[i] = int(vi_h), float(ci_h)
+                    first = False
+                total += int(rounds_h)
+                if bool(stalled_h) or total >= cap:
+                    va[i], ca[i] = int(vo_h), float(co_h)
+                    rs[i] = total
+                    tables = tables2
+                    break
+            durs[i] = time.monotonic() - t_goal
+        metrics = StackMetrics(
+            violated_before=vb, violated_after=va, cost_before=cb,
+            cost_after=ca, rounds=rs,
+        )
+        return agg, metrics, time.monotonic() - t_stack, durs
+
     def optimizations(
         self,
         model: FlatClusterModel,
@@ -594,13 +762,20 @@ class GoalOptimizer:
 
         stats_before = _jit_compute_stats(model, dims.num_topics)
 
-        step = _stack_executable(
-            tuple(g.name for g in goals), dims, self._settings, self._mesh, static, agg
-        )
-        t_stack = time.monotonic()
-        agg, metrics = step(static, agg)
-        jax.block_until_ready(metrics)
-        stack_s = time.monotonic() - t_stack
+        goal_names_t = tuple(g.name for g in goals)
+        goal_durs: Optional[np.ndarray] = None
+        if self._settings.chunk_rounds > 0:
+            agg, metrics, stack_s, goal_durs = self._run_chunked(
+                goal_names_t, dims, static, agg
+            )
+        else:
+            step = _stack_executable(
+                goal_names_t, dims, self._settings, self._mesh, static, agg
+            )
+            t_stack = time.monotonic()
+            agg, metrics = step(static, agg)
+            jax.block_until_ready(metrics)
+            stack_s = time.monotonic() - t_stack
 
         final_model = model._replace(assignment=agg.assignment)
         stats_after = _jit_compute_stats(final_model, dims.num_topics)
@@ -622,9 +797,14 @@ class GoalOptimizer:
                 cost_before=float(metrics.cost_before[i]),
                 cost_after=float(metrics.cost_after[i]),
                 rounds=int(metrics.rounds[i]),
-                # the stack runs as one fused XLA program; per-goal wall-clock
-                # is not observable, so attribute time by round share
-                duration_s=stack_s * int(metrics.rounds[i]) / max(1, int(metrics.rounds.sum())),
+                # chunked mode measures per-goal wall-clock directly; inside
+                # one fused XLA call it is not observable, so attribute the
+                # stack wall by round share
+                duration_s=(
+                    float(goal_durs[i])
+                    if goal_durs is not None
+                    else stack_s * int(metrics.rounds[i]) / max(1, int(metrics.rounds.sum()))
+                ),
             )
             goal_results.append(gr)
             if progress is not None:

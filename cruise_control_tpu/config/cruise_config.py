@@ -99,6 +99,11 @@ def _config_def() -> ConfigDef:
              "Hot/cold broker pairs examined per swap round when moves stall.")
     d.define("optimizer.swap.candidate.replicas", Type.INT, 8, at_least(1), Importance.MEDIUM,
              "Candidate replicas per broker in the swap search grid.")
+    d.define("optimizer.chunk.rounds", Type.INT, 32, at_least(0), Importance.MEDIUM,
+             "Max optimizer rounds per device call (chunked goal machine); bounds device-call "
+             "duration for remote-TPU transports. 0 = single fused-stack call.")
+    d.define("optimizer.apply.waves", Type.INT, 8, at_least(1), Importance.MEDIUM,
+             "Conflict-free apply waves per round (sequential depth of the shortlist apply).")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

@@ -111,8 +111,18 @@ class ReassignmentJournalDriver(ClusterDriver):
     an external controller-side agent applies it and writes per-task acks
     into `journal_dir/completed/<execution_id>.json`. `poll()` merges new
     tasks into the journal (the reference merges with in-progress
-    reassignments) and `is_finished` checks the ack file — the same
-    write-then-watch contract as the ZK node, over a shared filesystem."""
+    reassignments) and `is_finished` CONSUMES the ack file (reads and
+    deletes) — the same write-then-watch contract as the ZK node, over a
+    shared filesystem.
+
+    Execution ids restart at 0 in every process, so acks are only meaningful
+    within the driver instance that started the movement: construction sweeps
+    any ack files a previous (crashed/restarted) process left behind —
+    otherwise a stale `completed/0.json` would mark this process's first
+    movement finished before the controller ever saw it. Journal entries from
+    a previous run are intentionally KEPT: `has_ongoing_reassignment` reports
+    them and the executor refuses to start over them, mirroring the
+    reference's ongoing-reassignment guard (cc/executor/Executor.java:494)."""
 
     def __init__(self, journal_dir: str):
         import os
@@ -122,6 +132,11 @@ class ReassignmentJournalDriver(ClusterDriver):
         os.makedirs(self._completed_dir, exist_ok=True)
         self._journal = os.path.join(journal_dir, "reassign_partitions.json")
         self._lock = threading.Lock()
+        for stale in os.listdir(self._completed_dir):
+            try:
+                os.unlink(os.path.join(self._completed_dir, stale))
+            except OSError:
+                pass
 
     def _read_journal(self) -> List[Dict]:
         import json
@@ -180,6 +195,12 @@ class ReassignmentJournalDriver(ClusterDriver):
                 if e.get("executionId") != task.execution_id
             ]
             self._write_journal(remaining)
+            # consume the ack so a later execution reusing this id (fresh
+            # process, ids restart at 0) can't be spuriously marked done
+            try:
+                os.unlink(ack)
+            except OSError:
+                pass
         return True
 
     def has_ongoing_reassignment(self) -> bool:

@@ -141,3 +141,11 @@ def place_aggregates(agg: Aggregates, mesh: Mesh) -> Aggregates:
         return jax.device_put(arr, _replicated(mesh))
 
     return Aggregates(**{k: place(k, v) for k, v in agg._asdict().items()})
+
+
+def place_replicated(tree, mesh: Mesh):
+    """Replicate every leaf of a pytree on the mesh (acceptance tables &co:
+    broker/topic-sized summaries that every shard reads in full)."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(jax.numpy.asarray(x), _replicated(mesh)), tree
+    )

@@ -202,23 +202,22 @@ class CruiseControlApp:
             res = Resource[resource]
         except KeyError:
             return self._json({"errorMessage": f"unknown resource {resource}"}, status=400)
-        try:
+        entries = int(request.query.get("entries", "100"))
+
+        def build():
             model, meta = self._facade._monitor.cluster_model()
-        except ValueError as e:
-            return self._json({"errorMessage": str(e)}, status=503)
-        pl = np.asarray(model.part_load)
-        col = {
-            Resource.CPU: pl[:, PartMetric.CPU_LEADER],
-            Resource.NW_IN: pl[:, PartMetric.NW_IN_LEADER],
-            Resource.NW_OUT: pl[:, PartMetric.NW_OUT_LEADER],
-            Resource.DISK: pl[:, PartMetric.DISK],
-        }[res]
-        n = min(int(request.query.get("entries", "100")), col.shape[0])
-        order = np.argsort(-col)[:n]
-        a = np.asarray(model.assignment)
-        # PartitionLoadState.java record shape: topic/partition/leader/followers
-        return self._json(
-            {
+            pl = np.asarray(model.part_load)
+            col = {
+                Resource.CPU: pl[:, PartMetric.CPU_LEADER],
+                Resource.NW_IN: pl[:, PartMetric.NW_IN_LEADER],
+                Resource.NW_OUT: pl[:, PartMetric.NW_OUT_LEADER],
+                Resource.DISK: pl[:, PartMetric.DISK],
+            }[res]
+            n = min(entries, col.shape[0])
+            order = np.argsort(-col)[:n]
+            a = np.asarray(model.assignment)
+            # PartitionLoadState.java record shape: topic/partition/leader/followers
+            return {
                 "records": [
                     {
                         "topic": meta.topic_names[int(model.topic_id[p])],
@@ -232,7 +231,15 @@ class CruiseControlApp:
                 ],
                 "version": 1,
             }
-        )
+
+        try:
+            # off the event loop: model build + the argsort over all
+            # partitions is heavy at scale and must not stall concurrent
+            # requests (same hazard as /load above)
+            payload = await asyncio.to_thread(build)
+        except ValueError as e:
+            return self._json({"errorMessage": str(e)}, status=503)
+        return self._json(payload)
 
     async def proposals(self, request) -> web.Response:
         goals = _goals(request)

@@ -173,6 +173,20 @@ class TestFullStack:
             "ReplicaDistributionGoal"
         ] == 0
 
+    def test_chunked_machine_equals_fused_stack(self, random_model):
+        """The chunked goal machine (bounded-duration device calls) must be
+        bit-identical to the single fused-stack call: same kernels, same
+        order, only the host/device call boundary differs."""
+        fused = GoalOptimizer().optimizations(random_model)
+        chunked = GoalOptimizer(
+            settings=OptimizerSettings(chunk_rounds=2)
+        ).optimizations(random_model)
+        assert np.array_equal(fused.final_assignment, chunked.final_assignment)
+        for gf, gc in zip(fused.goal_results, chunked.goal_results):
+            assert gf.rounds == gc.rounds, gf.name
+            assert gf.violated_brokers_after == gc.violated_brokers_after, gf.name
+            assert gf.cost_after == pytest.approx(gc.cost_after), gf.name
+
 
 class TestOptions:
     def test_excluded_partitions_never_move(self):

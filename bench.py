@@ -71,7 +71,8 @@ def _settings(batched: bool):
     chunk = int(os.environ.get("BENCH_CHUNK_ROUNDS", "16"))
     if batched:
         rounds = int(os.environ.get("BENCH_BATCHED_ROUNDS", "128"))
-        return OptimizerSettings(batch_k=256, max_rounds_per_goal=rounds, num_dst_candidates=16,
+        batch_k = int(os.environ.get("BENCH_BATCH_K", "256"))
+        return OptimizerSettings(batch_k=batch_k, max_rounds_per_goal=rounds, num_dst_candidates=16,
                                  num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
                                  chunk_rounds=chunk)
     # faithful greedy: one action per round in the shortlist path

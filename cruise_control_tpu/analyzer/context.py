@@ -359,16 +359,26 @@ def wave_select(score, src, dst, dst_host, valid, num_brokers: int, num_hosts: i
     imin = jnp.full((num_brokers + 1,), big).at[src_c].min(idx_c).at[dst_c].min(idx_c)
     sel = cand & (idx == imin[src_c]) & (idx == imin[dst_c])
     def unique_per_group(sel, claim_arrays, n_groups):
-        """Keep, per group id, only the lowest-index selected entry — over the
-        UNION of the claim arrays (an entry must win every group it claims, so
-        A's first claim conflicts with B's second)."""
+        """Keep, per group id, only the best-scoring selected entry (ties by
+        lowest index) — over the UNION of the claim arrays (an entry must win
+        every group it claims, so A's first claim conflicts with B's
+        second). Score-priority keeps the selector's invariant that the
+        globally best valid action always survives every filtering stage."""
         claims = [jnp.where(sel, c, n_groups) for c in claim_arrays]
-        idx_s = jnp.where(sel, idx, big)
+        s_sel = jnp.where(sel, s, -jnp.inf)
+        smax = jnp.full((n_groups + 1,), -jnp.inf)
+        for c in claims:
+            smax = smax.at[c].max(s_sel)
+        c_and = sel
+        for c in claims:
+            c_and = c_and & (s_sel >= smax[c])
+        idx_s = jnp.where(c_and, idx, big)
         cmin = jnp.full((n_groups + 1,), big)
         for c in claims:
             cmin = cmin.at[c].min(idx_s)
         for c in claims:
-            sel = sel & (idx == cmin[c])
+            sel = c_and & (idx == cmin[c])
+            c_and = sel
         return sel
 
     # at most one action lands per destination host per wave (swaps load both

@@ -60,7 +60,6 @@ from cruise_control_tpu.analyzer.context import (
     Dims,
     OptimizationOptions,
     StaticCtx,
-    apply_action,
     apply_actions_batch,
     build_static_ctx,
     compute_aggregates,
@@ -118,6 +117,12 @@ class OptimizerSettings:
     #: covering the full 2,600-broker stack runs for minutes and gets killed
     #: by the tunnel's RPC deadline). 0 = single fused call.
     chunk_rounds: int = 0
+    #: chunked mode: target wall-clock per device call. The first call of a
+    #: run uses `chunk_rounds` as its budget; every later call's budget is
+    #: re-derived from the measured rounds/second so small problems coalesce
+    #: into few large calls (sync overhead) while north-star problems stay
+    #: under the transport deadline.
+    chunk_target_s: float = 10.0
     #: conflict-free apply waves per round: shortlisted actions are applied in
     #: at most this many parallel waves (distinct src/dst brokers per wave)
     #: instead of one long sequential re-validated scan — the sequential depth
@@ -144,17 +149,14 @@ class OptimizerSettings:
 # distribution-round and swap kernels)
 
 
-def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Dims, k: int,
-                    tables=None):
-    """i32[K]: best eligible broker of each of the top-k racks by the goal's
-    destination preference — rack-diverse so RackAwareGoal always finds an
-    eligible rack among the candidates.
+def _table_demoted_pref(static: StaticCtx, gs, agg: Aggregates, goal: Goal, tables):
+    """f32[B]: the goal's destination preference, -inf for ineligible brokers,
+    with table-infeasible brokers demoted below every feasible one.
 
-    Brokers with no remaining headroom under the merged prior-goal tables are
-    demoted (not excluded — if a whole rack is saturated its least-bad broker
-    still represents it): a goal's own preference (e.g. NW_IN-lightest) is
+    Demoted, not excluded — if a whole rack is saturated its least-bad broker
+    still represents it: a goal's own preference (e.g. NW_IN-lightest) is
     blind to earlier goals' bounds, and in tight regimes the preferred broker
-    per rack is often table-infeasible while a feasible one sits next to it."""
+    is often table-infeasible while a feasible one sits next to it."""
     pref = goal.dst_preference(static, gs, agg)
     pref = jnp.where(static.replica_dst_ok, pref, -jnp.inf)
     if tables is not None:
@@ -166,6 +168,15 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
         )
         span = 1.0 + jnp.max(jnp.abs(jnp.where(jnp.isfinite(pref), pref, 0.0)))
         pref = jnp.where(headroom, pref, pref - 2.0 * span)
+    return pref
+
+
+def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Dims, k: int,
+                    tables=None):
+    """i32[K]: best eligible broker of each of the top-k racks by the goal's
+    (table-demoted) destination preference — rack-diverse so RackAwareGoal
+    always finds an eligible rack among the candidates."""
+    pref = _table_demoted_pref(static, gs, agg, goal, tables)
     nr = dims.num_racks
     rack_mask = static.broker_rack[None, :] == jnp.arange(nr)[:, None]  # [NR, B]
     per_rack = jnp.where(rack_mask, pref[None, :], -jnp.inf)
@@ -184,10 +195,12 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
 def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     """Build the per-goal optimization loop (rounds until no progress).
 
-    Returns goal_loop(static, agg, tables) -> (agg, rounds). NOT jitted —
-    it is traced as one segment of the fused whole-stack program
-    (_make_stack_step); `tables` are the merged acceptance bounds of the
-    goals already optimized before this one."""
+    Returns goal_loop(static, agg, tables, budget=None) ->
+    (agg, rounds, stalled); see its docstring. NOT jitted — it is traced as
+    one segment of the fused whole-stack program (_make_stack_step) or as one
+    switch branch of the chunked goal machine (_make_goal_machine); `tables`
+    are the merged acceptance bounds of the goals already optimized before
+    this one."""
     p_count, r = dims.num_partitions, dims.max_rf
     k_dst = max(1, min(settings.num_dst_candidates, dims.num_racks))
     k_sel = max(1, min(settings.batch_k, p_count))
@@ -236,39 +249,37 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         sel_kind = best_kind[top_p]
         sel_slot = best_slot[top_p]
         sel_dst0 = best_dst[top_p]
-        n_waves = max(1, min(settings.apply_waves, k_sel))
+        # NOT capped at k_sel: with rank-paired destinations, later waves are
+        # how a still-unapplied entry (greedy mode: THE entry) retries its
+        # next-preferred destination after a failed validation
+        n_waves = max(1, settings.apply_waves)
 
         # ---- conflict-free apply waves: each wave re-validates every not-yet
-        # -applied shortlist entry against the CURRENT aggregates (including
-        # re-choosing each move's destination — applying many stale-dst
-        # actions piles load onto the brokers that looked best at round
-        # start), then applies a broker-disjoint, score-prioritized subset at
-        # once. Sequential depth per round: apply_waves, not batch_k.
-        def wave(carry, _):
+        # -applied shortlist entry against the CURRENT aggregates, then
+        # applies a broker-disjoint, score-prioritized subset at once.
+        # Sequential depth per round: apply_waves, not batch_k.
+        #
+        # Destinations are RANK-PAIRED, not argmaxed: goal scores are largely
+        # separable (src term + dst term), so a per-entry argmax sends every
+        # entry to the same most-preferred broker and the per-destination
+        # uniqueness then admits ONE action per wave (measured: a 256-entry
+        # shortlist applying ~1 move/wave at 300 brokers). Pairing the i-th
+        # valid entry with the i-th-preferred eligible destination is the
+        # sorted-by-sorted matching, which is optimal for separable scores;
+        # rotating the pairing by the wave index retries failed pairs against
+        # different destinations, and exact validation drops any mispair (the
+        # next round's grid re-scores everything anyway).
+        def wave(carry, w):
             agg_c, applied_any, done = carry
             if goal.uses_moves:
-                # the original dst rides along as the last candidate so the
-                # re-choice can never lose an action the shortlist had
-                cands = jnp.concatenate(
-                    [jnp.broadcast_to(dst_cands[None, :], (k_sel, kk)), sel_dst0[:, None]],
-                    axis=1,
-                )  # [k_sel, kk+1]
-                nk = kk + 1
-                candK = build_selected(
-                    static.part_load,
-                    agg_c.assignment,
-                    jnp.broadcast_to(sel_p[:, None], (k_sel, nk)),
-                    jnp.broadcast_to(sel_kind[:, None], (k_sel, nk)),
-                    jnp.broadcast_to(sel_slot[:, None], (k_sel, nk)),
-                    cands,
-                )
-                s_k = score_batch(static, agg_c, candK, goal, gs, tables)
-                j = jnp.argmax(s_k, axis=1)
-                best_dst_now = jnp.take_along_axis(cands, j[:, None], axis=1)[:, 0]
+                pref = _table_demoted_pref(static, gs, agg_c, goal, tables)
+                dst_rank = jnp.argsort(-pref).astype(jnp.int32)  # [B] best-first
+                valid_e = ~done & jnp.isfinite(top_scores)
+                r = jnp.cumsum(valid_e.astype(jnp.int32)) - 1
+                paired = dst_rank[(r + w) % dims.num_brokers]
                 # leadership "dst" is wherever slot's replica lives NOW
                 fresh_dst = jnp.where(
-                    sel_kind == KIND_MOVE, best_dst_now,
-                    agg_c.assignment[sel_p, sel_slot],
+                    sel_kind == KIND_MOVE, paired, agg_c.assignment[sel_p, sel_slot]
                 )
             else:
                 fresh_dst = jnp.where(
@@ -294,8 +305,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         (agg2, applied_any, _), _ = jax.lax.scan(
             wave,
             (agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)),
-            None,
-            length=n_waves,
+            jnp.arange(n_waves, dtype=jnp.int32),
         )
         return agg2, applied_any
 
@@ -310,14 +320,16 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # hot/cold set width scales with broker count: selection staleness
         # within a round only hurts when the hot set is a large fraction of
         # the cluster (a 32-of-100 hot set measurably degraded quality; at
-        # 2,600 brokers a 64-wide set is 2.5% and cuts the sequential round
-        # count ~4x, which is what the <10s config-5 target is made of)
+        # 2,600 brokers a 128-wide set is 5% of the cluster). Wave apply made
+        # wide sets cheap — sequential depth per round is `apply_waves`
+        # regardless of width — and every extra hot broker is another drain
+        # source per round, which is what the <10s config-5 target is made of.
         adaptive = max(
-            settings.num_swap_pairs, min(64, dims.num_brokers // 32)
+            settings.num_swap_pairs, min(128, dims.num_brokers // 16)
         )
         swap_fn = make_swap_round(
             goal, (), dims, adaptive, settings.swap_candidates,
-            settings.swaps_per_broker,
+            settings.swaps_per_broker, apply_waves=settings.apply_waves,
         )
         # resource-distribution goals replace the global [P, R, K] shortlist
         # with the reference-shaped drain/fill round: per-broker steepest
@@ -664,20 +676,31 @@ class GoalOptimizer:
         rs = np.zeros(n, np.int32)
         durs = np.zeros(n, np.float64)
         cap = self._settings.max_rounds_per_goal
-        chunk = self._settings.chunk_rounds
+        target_s = self._settings.chunk_target_s
         t_stack = time.monotonic()
         for i in range(n):
             t_goal = time.monotonic()
             total = 0
             first = True
+            # per-goal round cost is near-constant but differs up to ~10x
+            # across goals: adapt within the goal, reset at each boundary
+            chunk = self._settings.chunk_rounds
             while True:
                 budget = min(chunk, cap - total)
+                t_call = time.monotonic()
                 agg, tables2, rounds, stalled, vi, ci, vo, co = machine(
                     static, agg, tables, jnp.int32(i), jnp.int32(max(1, budget))
                 )
                 rounds_h, stalled_h, vi_h, ci_h, vo_h, co_h = jax.device_get(
                     (rounds, stalled, vi, ci, vo, co)
                 )
+                call_s = time.monotonic() - t_call
+                if int(rounds_h) > 0 and call_s > 0:
+                    # adapt the per-call budget to the measured round rate:
+                    # small problems coalesce into few large calls, the
+                    # north-star scale stays under the transport deadline
+                    rate = int(rounds_h) / call_s
+                    chunk = max(1, min(4096, int(rate * target_s)))
                 if first:
                     vb[i], cb[i] = int(vi_h), float(ci_h)
                     first = False

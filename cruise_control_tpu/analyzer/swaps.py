@@ -65,7 +65,7 @@ def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Arr
 
 
 def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
-                    swaps_per_broker: int = 4):
+                    swaps_per_broker: int = 4, apply_waves: int = 0):
     """Build swap_round(static, agg, tables) -> (agg, applied_any) for a
     resource-distribution goal (jit-compatible; call inside the goal loop).
 
@@ -202,26 +202,33 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         score = jnp.where(ok & endpoint_ok & gs.active, h0 + c0 - h1 - c1, -jnp.inf)
 
         # conflict-free apply waves: per wave every hot broker nominates its
-        # best remaining swap from the round-start grid, nominations are
-        # re-validated against the CURRENT aggregates, and a broker-disjoint
-        # subset (both endpoints unique, both endpoint hosts unique — a swap
-        # loads BOTH ends) applies at once. Depth: `waves` sequential steps
-        # instead of the former n_pairs*swaps_per_broker-long scan.
-        waves = max(2, swaps_per_broker)
+        # best remaining swap with ONE cold partner — hot rank i paired with
+        # cold rank (i + wave) % N (a per-hot argmax over all colds would
+        # converge on the same best partner and serialize to one swap per
+        # wave; the rotation walks each hot broker through every partner
+        # across waves). Nominations are re-validated against the CURRENT
+        # aggregates — including the merged prior-goal tables — and a
+        # broker-disjoint subset (both endpoints unique, both endpoint hosts
+        # unique: a swap loads BOTH ends) applies at once. Depth: `waves`
+        # sequential steps instead of the former
+        # n_pairs*swaps_per_broker-long scan.
+        waves = max(apply_waves, swaps_per_broker, 4)
         rows0 = jnp.arange(n_pairs, dtype=jnp.int32)
         kind_move = jnp.full((n_pairs,), KIND_MOVE, dtype=jnp.int32)
         n_brokers = static.broker_capacity.shape[0]
         n_hosts = static.host_cpu_capacity_limit.shape[0]
 
-        def wave(carry, _):
+        def wave(carry, w):
             agg_c, any_applied, cell_blk = carry
-            flat = jnp.where(cell_blk, -jnp.inf, score).reshape(
-                n_pairs, n_pairs * k * k
-            )
+            j_idx = ((rows0 + w) % n_pairs).astype(jnp.int32)
+            block = jnp.take_along_axis(
+                jnp.where(cell_blk, -jnp.inf, score),
+                j_idx[:, None, None, None], axis=1,
+            )[:, 0]  # [NH, K, K]: this wave's cold partner per hot broker
+            flat = block.reshape(n_pairs, k * k)
             bi = jnp.argmax(flat, axis=1)
             bs = jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0]
-            j_idx = (bi // (k * k)).astype(jnp.int32)
-            a_idx = ((bi // k) % k).astype(jnp.int32)
+            a_idx = (bi // k).astype(jnp.int32)
             b_idx = (bi % k).astype(jnp.int32)
             p1 = hp[rows0, a_idx]
             s1 = hs[rows0, a_idx]
@@ -283,7 +290,9 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
             jnp.asarray(False),
             jnp.zeros((n_pairs, n_pairs, k, k), dtype=bool),
         )
-        (agg2, applied_any, _), _ = jax.lax.scan(wave, init, None, length=waves)
+        (agg2, applied_any, _), _ = jax.lax.scan(
+            wave, init, jnp.arange(waves, dtype=jnp.int32)
+        )
         return agg2, applied_any
 
     return swap_round
@@ -365,24 +374,29 @@ def make_distribution_round(goal, dims, n_hot: int = 16, k_rep: int = 16,
             lead_kind = jnp.full((j_lead,), KIND_LEADERSHIP, dtype=jnp.int32)
 
         # conflict-free apply waves (context.wave_select contract): per wave,
-        # every hot broker nominates its best remaining (replica, cold) cell
-        # from the round-start grid, nominations are re-scored against the
-        # CURRENT aggregates, and a broker-disjoint subset applies at once.
-        # Sequential depth per round: `waves`, vs the former
-        # n_hot*j_apply-long re-validated scan — at 2,600 brokers that is the
-        # difference between a ~10ms and a ~300ms round on TPU.
+        # every hot broker nominates its best remaining replica toward ONE
+        # cold broker — hot rank i paired with cold rank (i + wave) % C, the
+        # sorted-by-sorted matching. A per-hot argmax over ALL colds would
+        # send every hot broker to the same most-underloaded cold and the
+        # per-destination uniqueness would then admit one move per wave;
+        # rank-pairing keeps the full hot set moving in parallel, and the
+        # wave rotation retries failed pairs against other colds. Nominations
+        # are re-scored against the CURRENT aggregates and a broker-disjoint
+        # subset applies at once. Sequential depth per round: `waves`, vs the
+        # former n_hot*j_apply-long re-validated scan.
         rows0 = jnp.arange(n_hot, dtype=jnp.int32)
         kind_move = jnp.full((n_hot,), KIND_MOVE, dtype=jnp.int32)
         waves = max(apply_waves, j_apply, 4)
 
-        def wave(carry, _):
+        def wave(carry, w):
             agg_c, applied_any, cell_blk, rep_gone, lead_done = carry
             blocked = cell_blk | rep_gone[:, :, None]
-            flat = jnp.where(blocked, -jnp.inf, s).reshape(n_hot, k_rep * n_cold)
-            bi = jnp.argmax(flat, axis=1)
-            bs = jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0]
-            a_idx = (bi // n_cold).astype(jnp.int32)
-            c_idx = (bi % n_cold).astype(jnp.int32)
+            c_idx = ((rows0 + w) % n_cold).astype(jnp.int32)
+            col = jnp.take_along_axis(
+                jnp.where(blocked, -jnp.inf, s), c_idx[:, None, None], axis=2
+            )[:, :, 0]  # [V, K]: this wave's cold column per hot broker
+            a_idx = jnp.argmax(col, axis=1).astype(jnp.int32)
+            bs = jnp.take_along_axis(col, a_idx[:, None], axis=1)[:, 0]
             p_e = hp[rows0, a_idx]
             slot_e = hs[rows0, a_idx]
             dst_e = cold[c_idx]
@@ -437,7 +451,9 @@ def make_distribution_round(goal, dims, n_hot: int = 16, k_rep: int = 16,
             jnp.zeros((n_hot, k_rep), dtype=bool),
             jnp.zeros((j_lead,), dtype=bool),
         )
-        (agg2, applied_any, _, _, _), _ = jax.lax.scan(wave, init, None, length=waves)
+        (agg2, applied_any, _, _, _), _ = jax.lax.scan(
+            wave, init, jnp.arange(waves, dtype=jnp.int32)
+        )
         return agg2, applied_any
 
     return dist_round

@@ -1,0 +1,21 @@
+"""Operation audit log.
+
+The reference writes human-auditable operation lines to a dedicated
+`operationLogger` (the OPERATION_LOG logger in cc/executor/Executor.java and
+cc/detector/AnomalyDetector.java, routed to its own appender by
+config/log4j.properties). Same contract here: one logger, one line per
+externally-visible operation — execution started/stopped/finished, anomaly
+decisions, self-healing fixes — so an operator can reconstruct what the
+service DID without wading through debug logs. Route it to a file with
+standard logging config (`logging.getLogger("operationLogger")`).
+"""
+
+from __future__ import annotations
+
+import logging
+
+OPERATION_LOG = logging.getLogger("operationLogger")
+
+
+def op_log(fmt: str, *args) -> None:
+    OPERATION_LOG.info(fmt, *args)

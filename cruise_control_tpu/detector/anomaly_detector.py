@@ -91,13 +91,19 @@ class AnomalyDetector:
         if self._facade._executor.has_ongoing_execution:
             self._requeue_later(anomaly, delay_s=1.0)
             return AnomalyNotificationResult.CHECK.name
+        from cruise_control_tpu.common.oplog import op_log
+
         result, delay_s = self._notifier.on_anomaly(anomaly, now_ms)
+        op_log("Anomaly %s: notifier decided %s", anomaly, result.name)
         if result == AnomalyNotificationResult.FIX:
             try:
                 anomaly.fix(self._facade)
                 self._fixes[anomaly.anomaly_type.name] += 1
-            except Exception:
-                pass  # fix failures surface through executor/notifier state
+                op_log("Self-healing fix completed for %s", anomaly)
+            except Exception as e:
+                # fix failures surface through executor/notifier state, but
+                # the audit trail must still record them
+                op_log("Self-healing fix FAILED for %s: %r", anomaly, e)
         elif result == AnomalyNotificationResult.CHECK:
             self._requeue_later(anomaly, delay_s)
         return result.name

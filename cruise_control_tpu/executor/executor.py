@@ -146,7 +146,13 @@ class Executor:
             for b in demoted_brokers or ():
                 self._demoted_brokers[b] = now
 
+        from cruise_control_tpu.common.oplog import op_log
+
         self._notifier("execution_started", {"numProposals": len(proposals)})
+        op_log(
+            "Execution started: %d proposal(s), removed=%s demoted=%s",
+            len(proposals), sorted(removed_brokers or ()), sorted(demoted_brokers or ()),
+        )
         if self._monitor is not None:
             self._monitor.pause_metric_sampling("proposal execution")
         try:
@@ -159,6 +165,10 @@ class Executor:
             stopped = self._stop_requested.is_set()
             self._notifier(
                 "execution_stopped" if stopped else "execution_finished", summary
+            )
+            op_log(
+                "Execution %s: %s",
+                "stopped by user" if stopped else "finished", summary,
             )
             return {**summary, "stopped": stopped}
         finally:

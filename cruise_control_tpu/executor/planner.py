@@ -20,8 +20,15 @@ from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
 
 class ExecutionTaskPlanner:
     def __init__(self, default_strategy: Optional[ReplicaMovementStrategy] = None):
+        import time
+
         self._strategy = default_strategy or BaseReplicaMovementStrategy()
-        self._execution_id = 0
+        # ids are epoch-seeded so they are unique ACROSS process restarts:
+        # external drivers (ReassignmentJournalDriver) key completion acks by
+        # execution id on shared storage, and a restarted process reusing id
+        # 0 could be spuriously "completed" by an ack written for its
+        # predecessor (100k ids per second of restart gap before collision)
+        self._execution_id = int(time.time()) * 100_000
         self._remaining_moves: List[ExecutionTask] = []
         self._remaining_leaderships: List[ExecutionTask] = []
 

@@ -108,6 +108,11 @@ def main(argv=None) -> int:
     parser.add_argument("--simulate-topics", type=int, default=20)
     parser.add_argument("--seed", type=int, default=42)
     parser.add_argument("--two-step-verification", action="store_true")
+    parser.add_argument("--access-log", default=None, metavar="PATH",
+                        help="append HTTP requests to PATH in NCSA combined format")
+    parser.add_argument("--operation-log", default=None, metavar="PATH",
+                        help="append the operation audit trail (executions, anomaly "
+                             "decisions, self-healing fixes) to PATH")
     args = parser.parse_args(argv)
 
     # probe the default backend before anything touches JAX: a dead TPU
@@ -126,9 +131,18 @@ def main(argv=None) -> int:
         num_brokers=args.simulate_brokers, num_topics=args.simulate_topics,
         seed=args.seed, two_step_verification=args.two_step_verification,
     )
+    if args.operation_log:
+        import logging
+
+        from cruise_control_tpu.common.oplog import OPERATION_LOG
+
+        handler = logging.FileHandler(args.operation_log)
+        handler.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+        OPERATION_LOG.addHandler(handler)
+        OPERATION_LOG.setLevel(logging.INFO)
     start_background(parts)
     print(f"cruise-control-tpu serving on http://{args.host}:{args.port}/kafkacruisecontrol/state")
-    run_server(app, host=args.host, port=args.port)
+    run_server(app, host=args.host, port=args.port, access_log_path=args.access_log)
     return 0
 
 

@@ -468,5 +468,32 @@ class CruiseControlApp:
         return app
 
 
-def run_server(app: CruiseControlApp, host: str = "127.0.0.1", port: int = 9090) -> None:
-    web.run_app(app.build_app(), host=host, port=port)
+#: NCSA combined log format (KafkaCruiseControlMain.java:78-89 wires Jetty's
+#: NCSARequestLog; aiohttp's atoms map 1:1)
+NCSA_LOG_FORMAT = '%a - - %t "%r" %s %b "%{Referer}i" "%{User-Agent}i"'
+
+
+def run_server(
+    app: CruiseControlApp,
+    host: str = "127.0.0.1",
+    port: int = 9090,
+    access_log_path: str = None,
+) -> None:
+    """Serve; when `access_log_path` is given, HTTP requests are appended
+    there in NCSA combined format (the reference's optional Jetty access
+    log)."""
+    import logging
+
+    access_logger = None
+    if access_log_path:
+        access_logger = logging.getLogger("cruise_control_tpu.access")
+        access_logger.setLevel(logging.INFO)
+        access_logger.propagate = False
+        access_logger.addHandler(logging.FileHandler(access_log_path))
+    web.run_app(
+        app.build_app(),
+        host=host,
+        port=port,
+        access_log=access_logger,
+        access_log_format=NCSA_LOG_FORMAT,
+    )

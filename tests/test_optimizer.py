@@ -11,6 +11,7 @@ from cruise_control_tpu.analyzer.context import (
     build_static_ctx,
     compute_aggregates,
     dims_of,
+    wave_select,
 )
 from cruise_control_tpu.analyzer.goals import HARD_GOAL_NAMES, goals_by_priority
 from cruise_control_tpu.analyzer.optimizer import (
@@ -172,6 +173,38 @@ class TestFullStack:
         assert _violations(fixed, ["ReplicaDistributionGoal"])[
             "ReplicaDistributionGoal"
         ] == 0
+
+    def test_wave_select_disjointness(self):
+        """The wave selector's contract (context.wave_select): among selected
+        entries no broker appears twice (either endpoint), no destination
+        host or partition receives two actions, and the selected set is
+        non-empty whenever any entry is valid."""
+        rng = np.random.default_rng(3)
+        n, n_brokers, n_hosts, n_parts = 64, 10, 5, 40
+        for trial in range(20):
+            src = rng.integers(0, n_brokers, n).astype(np.int32)
+            dst = rng.integers(0, n_brokers, n).astype(np.int32)
+            parts = rng.integers(0, n_parts, n).astype(np.int32)
+            host = (dst % n_hosts).astype(np.int32)
+            valid = (rng.random(n) < 0.7) & (src != dst)
+            score = rng.random(n).astype(np.float32)
+            sel = np.asarray(
+                wave_select(
+                    score, src, dst, host, valid, n_brokers, n_hosts,
+                    parts=(parts,), num_partitions=n_parts,
+                )
+            )
+            assert not (sel & ~valid).any()
+            brokers = np.concatenate([src[sel], dst[sel]])
+            assert len(brokers) == len(set(brokers.tolist())), trial
+            assert len(host[sel]) == len(set(host[sel].tolist())), trial
+            assert len(parts[sel]) == len(set(parts[sel].tolist())), trial
+            if valid.any():
+                assert sel.any(), trial
+            # the globally best valid entry always survives
+            if valid.any():
+                best = int(np.argmax(np.where(valid, score, -np.inf)))
+                assert sel[best], trial
 
     def test_chunked_machine_equals_fused_stack(self, random_model):
         """The chunked goal machine (bounded-duration device calls) must be

@@ -64,6 +64,7 @@ GOAL_REGISTRY: Dict[str, Goal] = {
 }
 
 HARD_GOAL_NAMES = [g.name for g in DEFAULT_GOAL_ORDER if g.is_hard]
+SOFT_GOAL_NAMES = [g.name for g in DEFAULT_GOAL_ORDER if not g.is_hard]
 
 
 def is_kafka_assigner_mode(names: Sequence[str] | None) -> bool:

@@ -269,22 +269,9 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # rotating the pairing by the wave index retries failed pairs against
         # different destinations, and exact validation drops any mispair (the
         # next round's grid re-scores everything anyway).
-        def wave(carry, w):
-            agg_c, applied_any, done = carry
-            if goal.uses_moves:
-                pref = _table_demoted_pref(static, gs, agg_c, goal, tables)
-                dst_rank = jnp.argsort(-pref).astype(jnp.int32)  # [B] best-first
-                valid_e = ~done & jnp.isfinite(top_scores)
-                r = jnp.cumsum(valid_e.astype(jnp.int32)) - 1
-                paired = dst_rank[(r + w) % dims.num_brokers]
-                # leadership "dst" is wherever slot's replica lives NOW
-                fresh_dst = jnp.where(
-                    sel_kind == KIND_MOVE, paired, agg_c.assignment[sel_p, sel_slot]
-                )
-            else:
-                fresh_dst = jnp.where(
-                    sel_kind == KIND_MOVE, sel_dst0, agg_c.assignment[sel_p, sel_slot]
-                )
+        all_brokers = jnp.arange(dims.num_brokers, dtype=jnp.int32)
+
+        def wave_with_dst(agg_c, applied_any, done, fresh_dst):
             act = build_selected(
                 static.part_load, agg_c.assignment, sel_p, sel_kind, sel_slot, fresh_dst
             )
@@ -300,13 +287,55 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 dims.num_brokers, dims.num_hosts,
             )
             agg_c = apply_actions_batch(static, agg_c, act, w_sel)
-            return (agg_c, applied_any | jnp.any(w_sel), done | w_sel), None
+            return agg_c, applied_any | jnp.any(w_sel), done | w_sel
 
-        (agg2, applied_any, _), _ = jax.lax.scan(
+        def lead_dst(agg_c):
+            return agg_c.assignment[sel_p, sel_slot]
+
+        def wave(carry, w):
+            agg_c, applied_any, done = carry
+            if goal.uses_moves:
+                pref = _table_demoted_pref(static, gs, agg_c, goal, tables)
+                dst_rank = jnp.argsort(-pref).astype(jnp.int32)  # [B] best-first
+                # rank only MOVE entries: leadership entries ignore `paired`,
+                # and letting them consume destination ranks would push move
+                # entries off their preferred destinations
+                valid_e = ~done & jnp.isfinite(top_scores) & (sel_kind == KIND_MOVE)
+                r = jnp.cumsum(valid_e.astype(jnp.int32)) - 1
+                paired = dst_rank[(r + w) % dims.num_brokers]
+                # leadership "dst" is wherever slot's replica lives NOW
+                fresh_dst = jnp.where(sel_kind == KIND_MOVE, paired, lead_dst(agg_c))
+            else:
+                fresh_dst = jnp.where(sel_kind == KIND_MOVE, sel_dst0, lead_dst(agg_c))
+            agg_c, applied_any, done = wave_with_dst(agg_c, applied_any, done, fresh_dst)
+            return (agg_c, applied_any, done), None
+
+        carry, _ = jax.lax.scan(
             wave,
             (agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)),
             jnp.arange(n_waves, dtype=jnp.int32),
         )
+        agg2, applied_any, done = carry
+        if goal.uses_moves:
+            # precision wave: rank-pairing tries `n_waves` destinations per
+            # entry per round, which is plenty mid-run but can miss the ONE
+            # legal destination of the last violated broker and stall the
+            # goal a step early (the greedy fixes it, breaking the <= greedy
+            # parity contract). One argmax-over-all-brokers wave per round
+            # restores exact greedy tail behavior; for batch_k=1 this IS the
+            # reference's full eligible-destination scan.
+            candB = build_selected(
+                static.part_load,
+                agg2.assignment,
+                jnp.broadcast_to(sel_p[:, None], (k_sel, dims.num_brokers)),
+                jnp.broadcast_to(sel_kind[:, None], (k_sel, dims.num_brokers)),
+                jnp.broadcast_to(sel_slot[:, None], (k_sel, dims.num_brokers)),
+                jnp.broadcast_to(all_brokers[None, :], (k_sel, dims.num_brokers)),
+            )
+            s_b = score_batch(static, agg2, candB, goal, gs, tables)
+            best = jnp.argmax(s_b, axis=1).astype(jnp.int32)
+            fresh_dst = jnp.where(sel_kind == KIND_MOVE, best, lead_dst(agg2))
+            agg2, applied_any, done = wave_with_dst(agg2, applied_any, done, fresh_dst)
         return agg2, applied_any
 
     swap_fn = None
@@ -439,7 +468,15 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
     return jax.jit(stack_step)
 
 
-@functools.lru_cache(maxsize=32)
+#: Cache sizes are a hard resource bound, not just a speed knob: every
+#: compiled stack/machine program pins ~1,000 memory mappings on XLA:CPU
+#: (measured: ~1,050 maps/program), and vm.max_map_count defaults to 65,530 —
+#: a process holding ~60 big programs SEGFAULTS inside the next compile.
+#: Production uses 1-2 programs; only test suites churn dozens.
+_PROGRAM_CACHE_SIZE = 8
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
 def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
     """One fused program per (goal stack, dims, settings)."""
     return _make_stack_step(goal_names, dims, settings)
@@ -493,7 +530,7 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     return jax.jit(machine)
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
 def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
     return _make_goal_machine(goal_names, dims, settings)
 
@@ -506,7 +543,7 @@ def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Opti
 #: cache (cruise_control_tpu.compile_cache), a production deployment compiles
 #: the stack once, ever.
 _COMPILED_STACKS: "collections.OrderedDict" = collections.OrderedDict()
-_COMPILED_STACKS_MAX = 16
+_COMPILED_STACKS_MAX = _PROGRAM_CACHE_SIZE
 _BUILD_LOCK = threading.Lock()
 
 
@@ -537,7 +574,18 @@ def _compile_cached(key, tag, dims, build):
     return ex
 
 
+def _trace_settings(settings: OptimizerSettings) -> OptimizerSettings:
+    """Settings normalized to the fields the TRACED program depends on.
+
+    chunk_rounds/chunk_target_s only drive the host loop (the machine's round
+    budget is a traced scalar); keying compiled programs on them would force
+    a byte-identical recompile — minutes at north-star scale — every time an
+    operator tunes a transport deadline."""
+    return dataclasses.replace(settings, chunk_rounds=0, chunk_target_s=0.0)
+
+
 def _stack_executable(goal_names, dims, settings, mesh, static, agg):
+    settings = _trace_settings(settings)
     key = ("stack", goal_names, dims, settings, mesh)
     tag = (
         f"fused goal stack ({len(goal_names)} goals"
@@ -550,6 +598,7 @@ def _stack_executable(goal_names, dims, settings, mesh, static, agg):
 
 
 def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
+    settings = _trace_settings(settings)
     key = ("machine", goal_names, dims, settings, mesh)
     tag = (
         f"chunked goal machine ({len(goal_names)} goals"
@@ -717,27 +766,15 @@ class GoalOptimizer:
         )
         return agg, metrics, time.monotonic() - t_stack, durs
 
-    def optimizations(
+    def _prepare(
         self,
         model: FlatClusterModel,
-        goal_names: Optional[Sequence[str]] = None,
-        options: OptimizationOptions = OptimizationOptions(),
-        raise_on_hard_failure: bool = True,
-        progress=None,
-    ) -> OptimizerResult:
-        """Runs the requested goal stack and diffs initial vs final placement.
-
-        The stack executes as ONE fused XLA program, so hard-goal failures
-        raise only after the whole stack ran (the reference stops at the first
-        hard failure mid-stack; the outcome for the caller is the same
-        exception), and `progress` — the analog of the reference's
-        OperationProgress steps (cc/async/progress/OptimizationForGoal) — is
-        invoked per goal in one burst AFTER the stack completes, with each
-        goal's round-share of the measured stack wall-clock (an attribution,
-        not a per-goal measurement; compile time is excluded)."""
-        from cruise_control_tpu.common.sensors import REGISTRY
-
-        t0 = time.monotonic()
+        goal_names: Optional[Sequence[str]],
+        options: OptimizationOptions,
+    ):
+        """Shared front half of optimizations()/warmup(): pad + bucket +
+        (mesh-)place the model, build the static context and initial
+        aggregates. Returns (goals, p_orig, model, dims, static, agg)."""
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
         from cruise_control_tpu.parallel.sharding import (
@@ -777,11 +814,88 @@ class GoalOptimizer:
             # replicas and bounds [0, 0], so they are inert.
             dims = dataclasses.replace(dims, num_topics=partition_bucket(dims.num_topics))
         static = build_static_ctx(model, self._constraint, dims, options)
-        init_assignment = jnp.asarray(model.assignment)
-        agg = _jit_compute_aggregates(static, init_assignment, dims)
+        agg = _jit_compute_aggregates(static, jnp.asarray(model.assignment), dims)
         if self._mesh is not None:
             static = place_static(static, self._mesh)
             agg = place_aggregates(agg, self._mesh)
+        return goals, p_orig, model, dims, static, agg
+
+    def warmup(
+        self,
+        model: FlatClusterModel,
+        goal_names: Optional[Sequence[str]] = None,
+        options: OptimizationOptions = OptimizationOptions(),
+    ) -> float:
+        """Compile the executor for this model's shape without paying a full
+        optimization. Chunked mode runs ONE budget-1 machine call (the budget
+        is a traced scalar, so the compiled program is the production one);
+        fused mode must execute the whole stack to return, so it falls back
+        to a full run. Returns seconds spent; the next optimizations() on the
+        same shape pays zero compile. The production precompute loop
+        (GoalOptimizer.java:129 background thread) is the reference analog."""
+        t0 = time.monotonic()
+        goals, _, model, dims, static, agg = self._prepare(model, goal_names, options)
+        goal_names_t = tuple(g.name for g in goals)
+        # the stats program runs in every optimizations() call too — without
+        # this, its first-use compile would contaminate the first timed run
+        jax.block_until_ready(_jit_compute_stats(model, dims.num_topics))
+        if self._settings.chunk_rounds > 0:
+            from cruise_control_tpu.analyzer.acceptance import empty_tables as _empty
+
+            tables = _empty(dims)
+            if self._mesh is not None:
+                from cruise_control_tpu.parallel.sharding import place_replicated
+
+                tables = place_replicated(tables, self._mesh)
+            machine = _machine_executable(
+                goal_names_t, dims, self._settings, self._mesh, static, agg, tables
+            )
+            out = machine(static, agg, tables, jnp.int32(0), jnp.int32(1))
+            jax.block_until_ready(out[3])
+        else:
+            step = _stack_executable(
+                goal_names_t, dims, self._settings, self._mesh, static, agg
+            )
+            _, metrics = step(static, agg)
+            jax.block_until_ready(metrics)
+        return time.monotonic() - t0
+
+    def optimizations(
+        self,
+        model: FlatClusterModel,
+        goal_names: Optional[Sequence[str]] = None,
+        options: OptimizationOptions = OptimizationOptions(),
+        raise_on_hard_failure: bool = True,
+        progress=None,
+    ) -> OptimizerResult:
+        """Runs the requested goal stack and diffs initial vs final placement.
+
+        The stack executes as ONE fused XLA program, so hard-goal failures
+        raise only after the whole stack ran (the reference stops at the first
+        hard failure mid-stack; the outcome for the caller is the same
+        exception), and `progress` — the analog of the reference's
+        OperationProgress steps (cc/async/progress/OptimizationForGoal) — is
+        invoked per goal in one burst AFTER the stack completes, with each
+        goal's round-share of the measured stack wall-clock (an attribution,
+        not a per-goal measurement; compile time is excluded)."""
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        t0 = time.monotonic()
+        goals, p_orig, model, dims, static, agg = self._prepare(
+            model, goal_names, options
+        )
+        if not goals:
+            # an explicitly empty goal list is a no-op, not an error (the
+            # reference just runs zero optimize() calls); None means defaults
+            stats = jax.device_get(_jit_compute_stats(model, dims.num_topics))
+            return OptimizerResult(
+                proposals=[], goal_results=[], stats_before=stats,
+                stats_after=stats,
+                final_assignment=np.asarray(model.assignment)[:p_orig],
+                num_replica_moves=0, num_leadership_moves=0,
+                data_to_move_mb=0.0, duration_s=time.monotonic() - t0,
+            )
+        init_assignment = jnp.asarray(model.assignment)
 
         stats_before = _jit_compute_stats(model, dims.num_topics)
 

@@ -220,16 +220,37 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
 
         def wave(carry, w):
             agg_c, any_applied, cell_blk = carry
-            j_idx = ((rows0 + w) % n_pairs).astype(jnp.int32)
-            block = jnp.take_along_axis(
-                jnp.where(cell_blk, -jnp.inf, score),
-                j_idx[:, None, None, None], axis=1,
-            )[:, 0]  # [NH, K, K]: this wave's cold partner per hot broker
-            flat = block.reshape(n_pairs, k * k)
-            bi = jnp.argmax(flat, axis=1)
-            bs = jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0]
-            a_idx = (bi // k).astype(jnp.int32)
-            b_idx = (bi % k).astype(jnp.int32)
+            masked = jnp.where(cell_blk, -jnp.inf, score)
+
+            # rank-paired partner per wave; the LAST wave argmaxes over ALL
+            # partners — the tail's one compatible exchange may not be the
+            # rotation's pick (see dist_round)
+            def paired(masked):
+                j_i = ((rows0 + w) % n_pairs).astype(jnp.int32)
+                block = jnp.take_along_axis(
+                    masked, j_i[:, None, None, None], axis=1
+                )[:, 0].reshape(n_pairs, k * k)
+                bi = jnp.argmax(block, axis=1)
+                return (
+                    j_i,
+                    (bi // k).astype(jnp.int32),
+                    (bi % k).astype(jnp.int32),
+                    jnp.take_along_axis(block, bi[:, None], axis=1)[:, 0],
+                )
+
+            def argmax_all(masked):
+                flat = masked.reshape(n_pairs, n_pairs * k * k)
+                bi = jnp.argmax(flat, axis=1)
+                return (
+                    (bi // (k * k)).astype(jnp.int32),
+                    ((bi // k) % k).astype(jnp.int32),
+                    (bi % k).astype(jnp.int32),
+                    jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0],
+                )
+
+            j_idx, a_idx, b_idx, bs = jax.lax.cond(
+                w == waves - 1, argmax_all, paired, masked
+            )
             p1 = hp[rows0, a_idx]
             s1 = hs[rows0, a_idx]
             p2 = cp[j_idx, b_idx]
@@ -391,12 +412,30 @@ def make_distribution_round(goal, dims, n_hot: int = 16, k_rep: int = 16,
         def wave(carry, w):
             agg_c, applied_any, cell_blk, rep_gone, lead_done = carry
             blocked = cell_blk | rep_gone[:, :, None]
-            c_idx = ((rows0 + w) % n_cold).astype(jnp.int32)
-            col = jnp.take_along_axis(
-                jnp.where(blocked, -jnp.inf, s), c_idx[:, None, None], axis=2
-            )[:, :, 0]  # [V, K]: this wave's cold column per hot broker
-            a_idx = jnp.argmax(col, axis=1).astype(jnp.int32)
-            bs = jnp.take_along_axis(col, a_idx[:, None], axis=1)[:, 0]
+            masked = jnp.where(blocked, -jnp.inf, s)
+            # rank-paired waves for throughput; the LAST wave argmaxes over
+            # the full (replica, cold) grid instead — precision for the tail,
+            # where the one legal pairing may not be the rotation's pick
+            # (grid argmax can collapse onto one cold broker, but as a final
+            # wave that still applies the single best remaining move)
+            def paired(masked):
+                c_i = ((rows0 + w) % n_cold).astype(jnp.int32)
+                col = jnp.take_along_axis(masked, c_i[:, None, None], axis=2)[:, :, 0]
+                a_i = jnp.argmax(col, axis=1).astype(jnp.int32)
+                return a_i, c_i, jnp.take_along_axis(col, a_i[:, None], axis=1)[:, 0]
+
+            def argmax_all(masked):
+                flat = masked.reshape(n_hot, k_rep * n_cold)
+                bi = jnp.argmax(flat, axis=1)
+                return (
+                    (bi // n_cold).astype(jnp.int32),
+                    (bi % n_cold).astype(jnp.int32),
+                    jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0],
+                )
+
+            a_idx, c_idx, bs = jax.lax.cond(
+                w == waves - 1, argmax_all, paired, masked
+            )
             p_e = hp[rows0, a_idx]
             slot_e = hs[rows0, a_idx]
             dst_e = cold[c_idx]

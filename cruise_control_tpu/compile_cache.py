@@ -24,14 +24,24 @@ def enable_persistent_cache(path: Optional[str] = None) -> Optional[str]:
     """Point JAX's compilation cache at a durable directory and drop the
     min-compile-time / min-entry-size gates so every program is cached.
 
-    Returns the cache dir, or None when no writable directory is available
-    (read-only install and no CRUISE_CONTROL_JAX_CACHE override) — the cache
-    is an accelerator, never a startup requirement."""
+    TPU-only: XLA:CPU AOT executable serialization is unreliable in this
+    build — the serializer can segfault on write (observed in
+    compilation_cache.put_executable_and_time) and the loader hard-aborts on
+    entries recorded under different target-machine features — so on a CPU
+    backend this is a no-op unless CRUISE_CONTROL_JAX_CACHE_FORCE=1. TPU
+    compiles are also the ones worth persisting (minutes at north-star
+    scale vs seconds on CPU).
+
+    Returns the cache dir, or None when disabled or no writable directory is
+    available — the cache is an accelerator, never a startup requirement."""
     global _enabled
     if _enabled is not None:
         return _enabled
     import jax
 
+    force = os.environ.get("CRUISE_CONTROL_JAX_CACHE_FORCE") == "1"
+    if not force and jax.default_backend() != "tpu":
+        return None
     cache_dir = os.path.abspath(
         path or os.environ.get("CRUISE_CONTROL_JAX_CACHE", _DEFAULT_DIR)
     )

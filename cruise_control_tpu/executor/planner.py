@@ -27,8 +27,9 @@ class ExecutionTaskPlanner:
         # external drivers (ReassignmentJournalDriver) key completion acks by
         # execution id on shared storage, and a restarted process reusing id
         # 0 could be spuriously "completed" by an ack written for its
-        # predecessor (100k ids per second of restart gap before collision)
-        self._execution_id = int(time.time()) * 100_000
+        # predecessor. Microsecond granularity: supervisors restart within
+        # the same second, which a seconds-based seed would collide on.
+        self._execution_id = time.time_ns() // 1_000
         self._remaining_moves: List[ExecutionTask] = []
         self._remaining_leaderships: List[ExecutionTask] = []
 

@@ -11,9 +11,28 @@ from cruise_control_tpu.platform_probe import pin_cpu
 
 pin_cpu(device_count=8)
 
-# Persistent compilation cache: XLA recompilation across fixture dims was ~90%
-# of the suite's 9-minute wall-clock; cached executables cut reruns to seconds
-# and rehearse the production warm-start path.
+# Persistent-cache wiring is exercised for coverage, but on the CPU backend
+# this is a no-op by design: XLA:CPU AOT executable serialization is
+# unreliable in this build (segfaulting writes, feature-mismatch aborts on
+# load) — see cruise_control_tpu/compile_cache.py. The suite pays its
+# recompiles; only TPU processes persist executables.
 from cruise_control_tpu.compile_cache import enable_persistent_cache
 
 enable_persistent_cache()
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _release_compiled_programs():
+    """Drop JAX's jit caches after every test module.
+
+    Compiled XLA:CPU executables pin ~1k memory mappings each (big stack
+    programs) and vm.max_map_count is 65,530: a suite that accumulates every
+    module's programs segfaults inside a later compile. The optimizer's own
+    executable caches are bounded (optimizer._PROGRAM_CACHE_SIZE); this
+    clears the unbounded global jit cache (per-dims helper programs)."""
+    yield
+    import jax
+
+    jax.clear_caches()

@@ -1,0 +1,90 @@
+"""Goal-robustness sweep — the RandomGoalTest / RandomSelfHealingTest analog
+(cct/analyzer/RandomGoalTest.java:64: single goals, repeated/shuffled goal
+lists, empty list, each checked through OptimizationVerifier post-conditions;
+cct/analyzer/RandomSelfHealingTest dead-broker variant).
+
+Our resolver re-sorts and dedups requested names (goals_by_priority), so
+repetition/shuffle collapse to subset selection; what must hold for ANY
+subset on ANY seeded model:
+
+- the run completes and proposals replay exactly to the final placement;
+- no requested goal's cost regresses (the verifier's REGRESSION check);
+- with dead brokers, the final placement hosts no replica on them
+  (DEAD_BROKERS check).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER, SOFT_GOAL_NAMES
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.models import generators
+from cruise_control_tpu.models.flat_model import sanity_check
+
+SETTINGS = OptimizerSettings(batch_k=32, max_rounds_per_goal=24, num_dst_candidates=8,
+                             num_swap_pairs=8, swap_candidates=8, apply_waves=4)
+
+
+@pytest.fixture(scope="module")
+def model():
+    prop = generators.ClusterProperty(
+        num_racks=4, num_brokers=12, num_topics=18,
+        mean_partitions_per_topic=7.0, replication_factor=2,
+        load_distribution="linear", mean_utilization=0.45,
+    )
+    return generators.random_cluster(seed=11, prop=prop)
+
+
+@pytest.mark.parametrize("goal_name", [g.name for g in DEFAULT_GOAL_ORDER])
+def test_single_goal(model, goal_name):
+    result = GoalOptimizer(settings=SETTINGS).optimizations(
+        model, goal_names=[goal_name], raise_on_hard_failure=False
+    )
+    fixed = model._replace(assignment=result.final_assignment)
+    sanity_check(fixed)
+    for g in result.goal_results:
+        assert g.cost_after <= g.cost_before + 1e-4, g.name
+
+
+def test_shuffled_repeated_soft_goals(model):
+    rng = np.random.default_rng(34534534)
+    names = list(SOFT_GOAL_NAMES) * 2
+    rng.shuffle(names)
+    result = GoalOptimizer(settings=SETTINGS).optimizations(
+        model, goal_names=names, raise_on_hard_failure=False
+    )
+    # dedup + re-sort: one result row per distinct goal, priority order
+    assert [g.name for g in result.goal_results] == [
+        n for n in [g.name for g in DEFAULT_GOAL_ORDER] if n in set(names)
+    ]
+    for g in result.goal_results:
+        assert g.cost_after <= g.cost_before + 1e-4, g.name
+
+
+def test_empty_goal_list_is_noop(model):
+    result = GoalOptimizer(settings=SETTINGS).optimizations(model, goal_names=[])
+    assert result.proposals == []
+    assert result.goal_results == []
+    assert np.array_equal(result.final_assignment, np.asarray(model.assignment))
+
+
+def test_random_subsets_with_dead_broker(model):
+    """RandomSelfHealingTest analog: any goal subset must evacuate dead
+    brokers and never regress the requested goals' costs."""
+    rng = np.random.default_rng(7)
+    state = np.asarray(model.broker_state).copy()
+    state[3] = BrokerState.DEAD
+    dead_model = model._replace(broker_state=state)
+    all_names = [g.name for g in DEFAULT_GOAL_ORDER]
+    for trial in range(3):
+        k = int(rng.integers(2, len(all_names)))
+        names = list(rng.choice(all_names, size=k, replace=False))
+        result = GoalOptimizer(settings=SETTINGS).optimizations(
+            dead_model, goal_names=names, raise_on_hard_failure=False
+        )
+        assert not (result.final_assignment == 3).any(), (trial, names)
+        fixed = dead_model._replace(assignment=result.final_assignment)
+        sanity_check(fixed)

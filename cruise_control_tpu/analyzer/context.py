@@ -27,7 +27,13 @@ from cruise_control_tpu.models.flat_model import FlatClusterModel
 
 @dataclasses.dataclass(frozen=True)
 class OptimizationOptions:
-    """Mask-encoded request options (cc/analyzer/OptimizationOptions.java:14)."""
+    """Mask-encoded request options (cc/analyzer/OptimizationOptions.java:14).
+
+    The `*_pattern`/`*_ids` fields are SYMBOLIC: a REST caller doesn't know
+    the model's partition/broker axes, so it names topics by regex and
+    brokers by id and `resolve_options` turns them into masks once the model
+    exists (the reference resolves excludedTopics the same way,
+    KafkaCruiseControlUtils/GoalUtils)."""
 
     #: replicas of these partitions may not be moved (excluded topics)
     excluded_partitions: Optional[np.ndarray] = None  # bool[P]
@@ -41,6 +47,52 @@ class OptimizationOptions:
     only_move_immigrants: bool = False
     #: triggered by the goal-violation detector (relaxes distribution margins)
     is_triggered_by_goal_violation: bool = False
+    #: regex over topic names; matching topics' partitions may not move
+    #: (resolved against the model by resolve_options)
+    excluded_topic_pattern: Optional[str] = None
+    #: broker ids that are the only valid destinations (resolved to the
+    #: requested_destination_brokers mask by resolve_options)
+    destination_broker_ids: Optional[tuple] = None
+
+
+def resolve_options(
+    options: OptimizationOptions, model, topic_names=None
+) -> OptimizationOptions:
+    """Materialize symbolic fields into masks for this model's axes."""
+    out = options
+    if options.excluded_topic_pattern is not None:
+        if topic_names is None:
+            raise ValueError(
+                "excluded_topic_pattern requires topic names (monitor-built model)"
+            )
+        import re
+
+        rx = re.compile(options.excluded_topic_pattern)
+        topic_ids = np.asarray(model.topic_id)
+        excluded_topics = np.array(
+            [bool(rx.fullmatch(name)) for name in topic_names], dtype=bool
+        )
+        mask = excluded_topics[topic_ids]
+        if options.excluded_partitions is not None:
+            mask = mask | np.asarray(options.excluded_partitions, dtype=bool)
+        out = dataclasses.replace(out, excluded_partitions=mask, excluded_topic_pattern=None)
+    if options.destination_broker_ids is not None:
+        bad = [
+            b for b in options.destination_broker_ids
+            if b < 0 or b >= model.num_brokers
+        ]
+        if bad:
+            raise ValueError(
+                f"destination_broker_ids out of range [0, {model.num_brokers}): {bad}"
+            )
+        dst = np.zeros(model.num_brokers, dtype=bool)
+        dst[list(options.destination_broker_ids)] = True
+        if out.requested_destination_brokers is not None:
+            dst = dst & np.asarray(out.requested_destination_brokers, dtype=bool)
+        out = dataclasses.replace(
+            out, requested_destination_brokers=dst, destination_broker_ids=None
+        )
+    return out
 
 
 class StaticCtx(NamedTuple):

@@ -777,6 +777,16 @@ class GoalOptimizer:
         aggregates. Returns (goals, p_orig, model, dims, static, agg)."""
         goals = goals_by_priority(goal_names)
         p_orig = model.num_partitions
+        if (
+            options.destination_broker_ids is not None
+            or options.excluded_topic_pattern is not None
+        ):
+            # broker ids resolve against any model; a topic regex needs the
+            # monitor's topic names and should have been resolved by the
+            # facade (resolve_options raises a clear error otherwise)
+            from cruise_control_tpu.analyzer.context import resolve_options
+
+            options = resolve_options(options, model)
         from cruise_control_tpu.parallel.sharding import (
             pad_partitions_to,
             partition_bucket,

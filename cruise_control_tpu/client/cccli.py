@@ -81,19 +81,22 @@ def build_parser() -> argparse.ArgumentParser:
         return p
 
     bools = {"action": "store_true"}
-    add("state")
+    add("state", ("--substates", {}))
     add("load")
     add("partition_load", ("--resource", {"default": "DISK"}), ("--entries", {"type": int, "default": 20}))
-    add("proposals", ("--goals", {}), ("--ignore-proposal-cache", bools))
+    add("proposals", ("--goals", {}), ("--ignore-proposal-cache", bools),
+        ("--excluded-topics", {}), ("--destination-broker-ids", {}))
     add("kafka_cluster_state", ("--verbose", bools))
     add("user_tasks")
     add("review_board")
     add("bootstrap", ("--start", {"type": int}), ("--end", {"type": int}))
     add("train", ("--start", {"type": int}), ("--end", {"type": int}))
     add("rebalance", ("--goals", {}), ("--dryrun", {"default": "true"}),
-        ("--skip-hard-goal-check", bools), ("--review-id", {}))
+        ("--skip-hard-goal-check", bools), ("--review-id", {}),
+        ("--excluded-topics", {}), ("--destination-broker-ids", {}))
     add("add_broker", ("brokerid", {}), ("--dryrun", {"default": "true"}), ("--review-id", {}))
-    add("remove_broker", ("brokerid", {}), ("--dryrun", {"default": "true"}), ("--review-id", {}))
+    add("remove_broker", ("brokerid", {}), ("--dryrun", {"default": "true"}), ("--review-id", {}),
+        ("--excluded-topics", {}), ("--destination-broker-ids", {}))
     add("demote_broker", ("brokerid", {}), ("--dryrun", {"default": "true"}), ("--review-id", {}))
     add("stop_proposal_execution")
     add("pause_sampling", ("--reason", {"default": "cccli"}))

@@ -113,9 +113,12 @@ ENDPOINT_PARAMETERS: Dict[str, Dict[str, CCParameter]] = {
         "resource": SingleChoiceParameter("resource", _RESOURCES),
         "entries": NonNegativeIntegerParameter("entries"),
     },
+    "state": {"substates": CCParameter("substates")},
     "proposals": {
         "goals": CCParameter("goals"),
         "ignore_proposal_cache": BooleanParameter("ignore_proposal_cache"),
+        "excluded_topics": RegexParameter("excluded_topics"),
+        "destination_broker_ids": CSVIntListParameter("destination_broker_ids"),
     },
     "kafka_cluster_state": {"verbose": BooleanParameter("verbose")},
     "bootstrap": {
@@ -131,6 +134,7 @@ ENDPOINT_PARAMETERS: Dict[str, Dict[str, CCParameter]] = {
         "dryrun": BooleanParameter("dryrun"),
         "skip_hard_goal_check": BooleanParameter("skip_hard_goal_check"),
         "excluded_topics": RegexParameter("excluded_topics"),
+        "destination_broker_ids": CSVIntListParameter("destination_broker_ids"),
         "review_id": NonNegativeIntegerParameter("review_id"),
         "ignore_proposal_cache": BooleanParameter("ignore_proposal_cache"),
     },
@@ -142,6 +146,8 @@ ENDPOINT_PARAMETERS: Dict[str, Dict[str, CCParameter]] = {
     "remove_broker": {
         "brokerid": CSVIntListParameter("brokerid"),
         "dryrun": BooleanParameter("dryrun"),
+        "excluded_topics": RegexParameter("excluded_topics"),
+        "destination_broker_ids": CSVIntListParameter("destination_broker_ids"),
         "review_id": NonNegativeIntegerParameter("review_id"),
     },
     "demote_broker": {

@@ -112,9 +112,24 @@ class CruiseControl:
             or options.excluded_brokers_for_leadership is not None
             or options.excluded_brokers_for_replica_move is not None
             or options.requested_destination_brokers is not None
+            or options.excluded_topic_pattern is not None
+            or options.destination_broker_ids is not None
             or options.only_move_immigrants
             or options.is_triggered_by_goal_violation
         )
+
+    @staticmethod
+    def _attach_topic_names(result: OptimizerResult, meta) -> OptimizerResult:
+        """Fill each proposal's topicPartition from the model metadata: the
+        reference's proposals are topic-partition keyed (ExecutionProposal),
+        and clients match on names, not dense partition indices."""
+        import dataclasses as _dc
+
+        result.proposals = [
+            _dc.replace(p, topic_partition=meta.topic_partition(p.partition))
+            for p in result.proposals
+        ]
+        return result
 
     def get_proposals(
         self,
@@ -152,6 +167,9 @@ class CruiseControl:
             with self._monitor.acquire_for_model_generation():
                 generation = self._monitor.generation
                 model, _meta = self._monitor.cluster_model(req)
+            from cruise_control_tpu.analyzer.context import resolve_options
+
+            options = resolve_options(options, model, _meta.topic_names)
         else:
             generation = -1
         result = self._optimizer.optimizations(
@@ -160,6 +178,8 @@ class CruiseControl:
             options=options,
             raise_on_hard_failure=not options.is_triggered_by_goal_violation,
         )
+        if generation >= 0:
+            result = self._attach_topic_names(result, _meta)
         if use_cache and generation >= 0:
             with self._cache_lock:
                 self._cached = _CachedProposals(result, generation, self._clock(), req)
@@ -190,9 +210,12 @@ class CruiseControl:
         goal_names: Optional[Sequence[str]] = None,
         dryrun: bool = True,
         skip_hard_goal_check: bool = False,
+        options: OptimizationOptions = OptimizationOptions(),
     ) -> OptimizerResult:
         """Drain brokers: mark DEAD then optimize so replicas move off them
         (KafkaCruiseControl.decommissionBrokers :187)."""
+        from cruise_control_tpu.analyzer.context import resolve_options
+
         self.sanity_check_hard_goal_presence(goal_names, skip_hard_goal_check)
         self._sanity_check_dry_run(dryrun)
         with self._monitor.acquire_for_model_generation():
@@ -203,8 +226,11 @@ class CruiseControl:
         state[list(broker_indices)] = BrokerState.DEAD
         model = model._replace(broker_state=state)
         result = self._optimizer.optimizations(
-            model, goal_names=self.goals_by_priority(goal_names) if goal_names else None
+            model,
+            goal_names=self.goals_by_priority(goal_names) if goal_names else None,
+            options=resolve_options(options, model, _meta.topic_names),
         )
+        result = self._attach_topic_names(result, _meta)
         if not dryrun:
             self._executor.execute_proposals(result.proposals, removed_brokers=broker_indices)
         return result
@@ -227,6 +253,7 @@ class CruiseControl:
         result = self._optimizer.optimizations(
             model, goal_names=self.goals_by_priority(goal_names) if goal_names else None
         )
+        result = self._attach_topic_names(result, _meta)
         if not dryrun:
             self._executor.execute_proposals(result.proposals)
         return result
@@ -249,6 +276,7 @@ class CruiseControl:
             goal_names=["LeaderReplicaDistributionGoal"],
             options=OptimizationOptions(excluded_brokers_for_leadership=mask),
         )
+        result = self._attach_topic_names(result, _meta)
         if not dryrun:
             self._executor.execute_proposals(result.proposals, demoted_brokers=broker_indices)
         return result

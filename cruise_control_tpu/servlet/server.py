@@ -61,6 +61,41 @@ def _brokerids(request) -> set:
     return {int(b) for b in raw.split(",")}
 
 
+def _request_options(request):
+    """Symbolic OptimizationOptions from query params: `excluded_topics`
+    (regex; matching topics' replicas may not move) and
+    `destination_broker_ids` (comma ids; the only valid destinations) —
+    resolved to masks by the facade once the model exists (where ids are
+    range-checked against the model's broker count)."""
+    from cruise_control_tpu.analyzer.context import OptimizationOptions
+
+    pattern = request.query.get("excluded_topics")
+    if pattern:
+        import re
+
+        try:
+            re.compile(pattern)
+        except re.error as e:
+            raise IllegalRequestException(f"excluded_topics: bad regex: {e}")
+    dst = request.query.get("destination_broker_ids")
+    ids = None
+    if dst:
+        try:
+            ids = tuple(int(b) for b in dst.split(",") if b)
+        except ValueError:
+            raise IllegalRequestException(
+                f"destination_broker_ids: expected comma-separated ids, got {dst!r}"
+            )
+        if not ids:
+            raise IllegalRequestException("destination_broker_ids: empty list")
+        if any(b < 0 for b in ids):
+            raise IllegalRequestException("destination_broker_ids: ids must be >= 0")
+    return OptimizationOptions(
+        excluded_topic_pattern=pattern or None,
+        destination_broker_ids=ids,
+    )
+
+
 class CruiseControlApp:
     """Wires the facade + async layer + task manager into an aiohttp app."""
 
@@ -174,6 +209,25 @@ class CruiseControlApp:
         out = self._facade.state()
         if self._detector is not None:
             out["AnomalyDetectorState"] = self._detector.state()
+        # substates filter (CruiseControlStateParameters): e.g.
+        # ?substates=monitor,executor (also the reference's spelling,
+        # anomaly_detector). Unknown names are a 400, not a silent {}.
+        wanted = request.query.get("substates")
+        if wanted:
+            def norm(s: str) -> str:
+                return s.strip().lower().replace("_", "").removesuffix("state")
+
+            available = {norm(k): k for k in out}
+            keys = [w for w in wanted.split(",") if w.strip()]
+            unknown = [w for w in keys if norm(w) not in available]
+            if unknown:
+                return self._json(
+                    {"errorMessage": f"unknown substates {unknown}; "
+                                     f"available: {sorted(available.values())}"},
+                    status=400,
+                )
+            chosen = {available[norm(w)] for w in keys}
+            out = {k: v for k, v in out.items() if k in chosen}
         return self._json(out)
 
     async def load(self, request) -> web.Response:
@@ -244,9 +298,15 @@ class CruiseControlApp:
     async def proposals(self, request) -> web.Response:
         goals = _goals(request)
         ignore_cache = _bool(request, "ignore_proposal_cache")
+        try:
+            options = _request_options(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
         return await self._async_op(
             request, "proposals",
-            lambda: self._acc.get_proposals(goal_names=goals, ignore_proposal_cache=ignore_cache),
+            lambda: self._acc.get_proposals(
+                goal_names=goals, ignore_proposal_cache=ignore_cache, options=options
+            ),
         )
 
     async def kafka_cluster_state(self, request) -> web.Response:
@@ -326,10 +386,16 @@ class CruiseControlApp:
         goals = _goals(request)
         dryrun = _bool(request, "dryrun", True)
         skip_hard = _bool(request, "skip_hard_goal_check")
+        ignore_cache = _bool(request, "ignore_proposal_cache")
+        try:
+            options = _request_options(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
         return await self._async_op(
             request, "rebalance",
             lambda: self._acc.rebalance(
-                goal_names=goals, dryrun=dryrun, skip_hard_goal_check=skip_hard
+                goal_names=goals, dryrun=dryrun, skip_hard_goal_check=skip_hard,
+                options=options, ignore_proposal_cache=ignore_cache,
             ),
         )
 
@@ -355,9 +421,13 @@ class CruiseControlApp:
         except IllegalRequestException as e:
             return self._json({"errorMessage": str(e)}, status=400)
         dryrun = _bool(request, "dryrun", True)
+        try:
+            options = _request_options(request)
+        except IllegalRequestException as e:
+            return self._json({"errorMessage": str(e)}, status=400)
         return await self._async_op(
             request, "remove_broker",
-            lambda: self._acc.decommission_brokers(brokers, dryrun=dryrun),
+            lambda: self._acc.decommission_brokers(brokers, dryrun=dryrun, options=options),
         )
 
     async def demote_broker(self, request) -> web.Response:

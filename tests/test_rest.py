@@ -109,6 +109,37 @@ def test_state_and_load_endpoints(server):
     pl = c.request("partition_load", {"resource": "NW_OUT", "entries": 5})
     assert len(pl["records"]) == 5
     assert "topicPartition" in pl["records"][0]
+    # substates filter (CruiseControlStateParameters analog)
+    only = c.request("state", {"substates": "monitor,executor"})
+    assert set(only) == {"MonitorState", "ExecutorState"}
+
+
+def test_rebalance_excluded_topics_and_destinations(server):
+    """excluded_topics (regex) must pin matching topics' replicas;
+    destination_broker_ids must confine every replica ADD to those brokers."""
+    c = client_for(server)
+    all_moves = c.request(
+        "rebalance", {"dryrun": "true", "ignore_proposal_cache": "true"}
+    )
+
+    def topic_of(p):
+        return p["topicPartition"].rpartition("-")[0]
+
+    moved_topics = {topic_of(p) for p in all_moves["proposals"]}
+    assert moved_topics, "fixture must produce at least one proposal"
+    excluded = sorted(moved_topics)[0]
+    out = c.request(
+        "rebalance",
+        {"dryrun": "true", "excluded_topics": excluded},
+    )
+    assert all(topic_of(p) != excluded for p in out["proposals"])
+    dst = c.request(
+        "rebalance",
+        {"dryrun": "true", "destination_broker_ids": "0,1"},
+    )
+    for p in dst["proposals"]:
+        adds = set(p["newReplicas"]) - set(p["oldReplicas"])
+        assert adds <= {0, 1}, p
 
 
 def test_kafka_cluster_state(server):

@@ -9,27 +9,39 @@ All five BASELINE configs (BASELINE.md), largest last:
 
 North star (BASELINE.md): config 5 through the complete default hard+soft
 goal stack in < 10 s wall-clock on a v5e-8 with goal-violation scores <= the
-stock greedy. The greedy reference is produced here too: configs 1-4 also run
-the faithful-greedy parity mode (batch_k=1 — one action per round, the
-reference's AbstractGoal semantics) and each JSON line carries a `parity`
-block comparing violated-goal sets and per-goal costs (the
-OptimizationVerifier post-condition, cct/analyzer/OptimizationVerifier.java:48).
+stock greedy. The greedy reference is produced here too: configs 1-4 run the
+faithful-greedy parity mode (batch_k=1: one action per round through the
+exhaustive [P, R, K] grid + full-destination scan, the reference's
+AbstractGoal semantics made strictly stronger), and config 5 runs the same
+parity contract on a downscaled model of the SAME family (exponential load,
+52 racks) — the largest scale at which the 512-round greedy is a meaningful
+baseline within the bench budget; the scale is stated in the JSON. Each
+parity comparison applies the OptimizationVerifier post-condition
+(cct/analyzer/OptimizationVerifier.java:48,:250): the batched engine may not
+violate any goal the greedy satisfies, and per-goal cost-after may not
+regress beyond epsilon. A parity failure zeroes vs_baseline — it IS a bench
+failure.
 
-Output contract: stdout carries ONLY JSON lines of the form
-  {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
-one per completed stage (configs run smallest-first, so a timeout still
-leaves the largest *completed* config as the last line — parse the last
-line). All diagnostics go to stderr, flushed, starting with backend/device
-info so a hang is attributable.
+Output contract: stdout carries ONLY compact JSON lines (<= ~500 bytes) of
+the form {"metric": "...", "value": N, "unit": "...", "vs_baseline": N, ...}
+— one per completed stage, configs smallest-first, so a timeout still leaves
+the largest *completed* config as the last line (parse the last line). The
+full per-goal and parity tables go to BENCH_DETAIL.json next to this file
+and to stderr. All diagnostics go to stderr, flushed, starting with
+backend/device info so a hang is attributable.
 
 `value` is the steady-state proposal-generation wall-clock (the production
 regime: the proposal precompute loop reuses compiled kernels across model
 generations, cc/analyzer/GoalOptimizer.java:129-179, so a warm-up pass
 compiles and the timed pass measures). `vs_baseline`:
-  config 5   = 10 s target / value       (> 1 means faster than the target)
+  config 5   = 10 s target / value       (> 1 means faster than the target;
+               forced to 0.0 if the parity gate fails)
   configs1-4 = greedy wall / batched wall (> 1 means faster than the faithful
-               greedy on the same hardware; the 10 s target is defined at
-               config-5 scale only)
+               greedy on the same hardware)
+
+When more than one accelerator device is visible, the model's partition axis
+is sharded over all of them (jax.sharding.Mesh via parallel.sharding); on a
+single chip the mesh is skipped (a 1-device mesh only adds padding).
 
 Platform handling: the default backend (TPU) is probed in a subprocess with
 a timeout first; if its init hangs (dead axon tunnel — the round-1 failure
@@ -38,7 +50,8 @@ mode), the run degrades to a labeled CPU number instead of dying silently.
 Usage: python bench.py [--smoke]        # --smoke = config 1 only, fast
 Env overrides: BENCH_CONFIG (single config 1-5), BENCH_SEED,
 BENCH_PROBE_TIMEOUT_S, BENCH_STAGES (comma list, default "1,2,3,4,5"),
-BENCH_PARITY=0 to skip the greedy passes.
+BENCH_PARITY=0 to skip the greedy passes, BENCH_PARITY5_BROKERS (parity
+model size for config 5, default 260).
 """
 
 from __future__ import annotations
@@ -50,19 +63,37 @@ import sys
 import time
 import traceback
 
+DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
+_DETAIL: dict = {"configs": []}
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def emit(payload: dict) -> None:
-    print(json.dumps(payload), flush=True)
+def emit(payload: dict, detail: dict | None = None) -> None:
+    """Compact line to stdout; full tables to BENCH_DETAIL.json + stderr."""
+    if detail:
+        record = dict(payload)
+        record.update(detail)
+        _DETAIL["configs"].append(record)
+        try:
+            with open(DETAIL_PATH, "w") as f:
+                json.dump(_DETAIL, f, indent=1)
+        except OSError as e:  # detail is best-effort; the stdout line is the contract
+            log(f"BENCH_DETAIL write failed: {e}")
+        log("detail: " + json.dumps(record))
+    line = json.dumps(payload)
+    if len(line) > 600:
+        log(f"WARNING: compact line is {len(line)} bytes (contract ~500)")
+    print(line, flush=True)
 
 
 TARGET_S = 10.0  # config-5 north star (BASELINE.md)
+PARITY_EPS = 1e-3  # per-goal cost-after regression tolerance (relative)
 
 
-def _settings(batched: bool, num_partitions: int = 1 << 30):
+def _settings(batched: bool):
     from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
 
     # chunked goal machine: bounds each device call's duration so the remote
@@ -71,20 +102,15 @@ def _settings(batched: bool, num_partitions: int = 1 << 30):
     chunk = int(os.environ.get("BENCH_CHUNK_ROUNDS", "16"))
     if batched:
         rounds = int(os.environ.get("BENCH_BATCHED_ROUNDS", "128"))
-        # shortlist width scales with the model: a 1,024-wide shortlist on a
-        # 1k-partition model is all of it (pure overhead), on 200k partitions
-        # it is the throughput the <10s target needs
-        batch_k = min(
-            int(os.environ.get("BENCH_BATCH_K", "1024")),
-            max(64, num_partitions // 8),
-        )
-        return OptimizerSettings(batch_k=batch_k, max_rounds_per_goal=rounds, num_dst_candidates=16,
+        return OptimizerSettings(batch_k=1024, max_rounds_per_goal=rounds,
+                                 num_dst_candidates=16,
                                  num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
                                  chunk_rounds=chunk)
-    # faithful greedy: one action per round in the shortlist path
-    # (AbstractGoal.maybeApplyBalancingAction); resource-distribution goals use
-    # the same reference-shaped per-broker drain/fill kernel in both modes but
-    # run here to deeper convergence (4x the rounds), making the greedy
+    # faithful greedy: one action per round through the exhaustive [P, R, K]
+    # grid + full-destination precision scan
+    # (AbstractGoal.maybeApplyBalancingAction); resource-distribution goals
+    # use the same reference-shaped drain/fill kernel in both modes but run
+    # here to deeper convergence (4x the rounds), making the greedy
     # reference a STRICTLY stronger baseline on those goals.
     return OptimizerSettings(batch_k=1, max_rounds_per_goal=512, num_dst_candidates=16,
                              num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
@@ -144,30 +170,68 @@ def _default_options():
 
 def _parity_block(cfg_id, batched_result, greedy_wall, greedy_result):
     """Side-by-side scores: batched must not violate more than the greedy
-    (the north star's 'scores <= stock greedy' contract)."""
+    AND may not regress any goal's final cost beyond epsilon (the north
+    star's 'scores <= stock greedy' contract = OptimizationVerifier's
+    REGRESSION check)."""
     batched_after = set(batched_result.violated_goals_after)
     greedy_after = set(greedy_result.violated_goals_after)
     worse = sorted(batched_after - greedy_after)
-    cost_delta = {
-        bg.name: round(bg.cost_after - gg.cost_after, 6)
-        for bg, gg in zip(batched_result.goal_results, greedy_result.goal_results)
-    }
+    cost_delta = {}
+    regressed = []
+    for bg, gg in zip(batched_result.goal_results, greedy_result.goal_results):
+        delta = bg.cost_after - gg.cost_after
+        cost_delta[bg.name] = round(delta, 6)
+        if delta > PARITY_EPS * max(1.0, abs(gg.cost_after)):
+            regressed.append(bg.name)
+    ok = not worse and not regressed
     block = {
         "greedyWallS": round(greedy_wall, 3),
         "greedyViolatedAfter": sorted(greedy_after),
         "batchedViolatedAfter": sorted(batched_after),
         "batchedWorseGoals": worse,  # must be []
+        "costRegressedGoals": regressed,  # must be []
         "costAfterDeltaVsGreedy": cost_delta,  # negative = batched better
+        "parityOk": ok,
         "greedyGoals": _goal_table(greedy_result),
     }
     log(
         f"[config {cfg_id}] parity: batched_violated={len(batched_after)} "
-        f"greedy_violated={len(greedy_after)} worse_goals={worse}"
+        f"greedy_violated={len(greedy_after)} worse_goals={worse} "
+        f"cost_regressed={regressed} ok={ok}"
     )
     return block
 
 
-def run_config(cfg_id: int, seed: int, platform: str, parity: bool) -> None:
+def _parity5(seed: int, mesh, batched_settings) -> dict:
+    """Config-5 parity at the largest greedy-convergent scale in budget:
+    the same model family (exponential load, 52 racks, rf 3) downscaled so
+    the 512-round-per-goal greedy is a meaningful baseline. Both modes run
+    on THIS model; the gate result applies to config 5's line."""
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+
+    brokers = int(os.environ.get("BENCH_PARITY5_BROKERS", "260"))
+    prop = ClusterProperty(
+        num_racks=52, num_brokers=brokers, num_topics=max(50, (brokers * 20) // 13),
+        mean_partitions_per_topic=50.0, replication_factor=3,
+        load_distribution="exponential",
+    )
+    model = random_cluster(seed + 5, prop)
+    log(
+        f"[config 5] parity model: {model.num_brokers} brokers / "
+        f"{model.num_partitions} partitions (config-5 family, downscaled)"
+    )
+    batched = GoalOptimizer(settings=batched_settings, mesh=mesh)
+    b_wall, b_result = _timed(batched, model, 5, "parity batched")
+    greedy = GoalOptimizer(settings=_settings(batched=False))
+    g_wall, g_result = _timed(greedy, model, 5, "parity greedy")
+    block = _parity_block(5, b_result, g_wall, g_result)
+    block["parityScale"] = f"{model.num_brokers}B/{model.num_partitions}P"
+    block["batchedWallS"] = round(b_wall, 3)
+    return block
+
+
+def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> None:
     import numpy as np
 
     from cruise_control_tpu.analyzer.context import OptimizationOptions
@@ -182,7 +246,8 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool) -> None:
         f"{model.num_partitions} partitions / rf {model.assignment.shape[1]} "
         f"(built in {time.monotonic() - t_build:.1f}s)"
     )
-    optimizer = GoalOptimizer(settings=_settings(batched=True, num_partitions=model.num_partitions))
+    settings = _settings(batched=True)
+    optimizer = GoalOptimizer(settings=settings, mesh=mesh)
 
     if cfg_id == 4:
         # add-broker: the 4 NEW brokers are the only eligible destinations
@@ -219,21 +284,22 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool) -> None:
             "addWallS": round(add_wall, 3),
             "removeWallS": round(drain_wall, 3),
             "removeEvacuatedCleanly": evacuated,
-            "goals": _goal_table(add_result),
         }
+        detail = {"goals": _goal_table(add_result)}
         if parity:
             greedy = GoalOptimizer(settings=_settings(batched=False))
             greedy_wall, greedy_result = _timed(
                 greedy, model, cfg_id, "greedy add-broker", options=add_opts
             )
-            payload["parity"] = _parity_block(cfg_id, add_result, greedy_wall, greedy_result)
+            detail["parity"] = _parity_block(cfg_id, add_result, greedy_wall, greedy_result)
+            payload["parityOk"] = detail["parity"]["parityOk"]
             # the greedy reference covers the add pass only; scope the ratio
             # to the same measurement so value * vs_baseline stays meaningful
             payload["vs_baseline"] = round(greedy_wall / max(add_wall, 1e-9), 3)
             payload["vsBaselineScope"] = "add-broker pass (greedyWallS / addWallS)"
         else:
             payload["vs_baseline"] = 0.0
-        emit(payload)
+        emit(payload, detail)
         return
 
     goal_names = None
@@ -258,21 +324,34 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool) -> None:
         "unit": "s",
         "moves": result.num_replica_moves,
         "leadershipMoves": result.num_leadership_moves,
-        "violatedAfter": result.violated_goals_after,
+        "violatedAfterCount": len(result.violated_goals_after),
+    }
+    detail = {
         "goals": _goal_table(result),
+        "violatedAfter": result.violated_goals_after,
     }
     if cfg_id == 5:
         payload["vs_baseline"] = round(TARGET_S / wall, 3)
+        if parity:
+            # the parity gate runs on the downscaled config-5-family model;
+            # a failure zeroes vs_baseline (the contract is time AND scores)
+            block = _parity5(seed, mesh, settings)
+            detail["parity"] = block
+            payload["parityOk"] = block["parityOk"]
+            payload["parityScale"] = block["parityScale"]
+            if not block["parityOk"]:
+                payload["vs_baseline"] = 0.0
     elif parity:
         greedy = GoalOptimizer(settings=_settings(batched=False))
         greedy_wall, greedy_result = _timed(
             greedy, model, cfg_id, "greedy", goal_names=goal_names
         )
-        payload["parity"] = _parity_block(cfg_id, result, greedy_wall, greedy_result)
+        detail["parity"] = _parity_block(cfg_id, result, greedy_wall, greedy_result)
+        payload["parityOk"] = detail["parity"]["parityOk"]
         payload["vs_baseline"] = round(greedy_wall / max(wall, 1e-9), 3)
     else:
         payload["vs_baseline"] = 0.0
-    emit(payload)
+    emit(payload, detail)
 
 
 def main() -> None:
@@ -299,7 +378,15 @@ def main() -> None:
     import jax
 
     platform = jax.default_backend()
-    log(f"backend: {platform}, devices: {jax.devices()}")
+    devices = jax.devices()
+    log(f"backend: {platform}, devices: {devices}")
+
+    mesh = None
+    if len(devices) > 1:
+        from cruise_control_tpu.parallel.sharding import make_mesh
+
+        mesh = make_mesh(len(devices))
+        log(f"mesh: sharding partition axis over {len(devices)} devices")
 
     seed = int(os.environ.get("BENCH_SEED", "42"))
     parity = os.environ.get("BENCH_PARITY", "1") != "0"
@@ -313,7 +400,7 @@ def main() -> None:
     completed = 0
     for cfg_id in stages:
         try:
-            run_config(cfg_id, seed, platform, parity=parity)
+            run_config(cfg_id, seed, platform, parity=parity, mesh=mesh)
             completed += 1
         except Exception:
             log(f"[config {cfg_id}] FAILED:\n{traceback.format_exc()}")

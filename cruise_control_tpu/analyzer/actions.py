@@ -90,6 +90,26 @@ def _follower_vec(part_load: jax.Array, p: jax.Array) -> jax.Array:
     )
 
 
+def slot_contrib(part_load: jax.Array, assignment: jax.Array, res: int) -> jax.Array:
+    """f32[P, R]: per-slot load contribution for one Resource (leader slots
+    carry the leader variant, followers the follower variant)."""
+    lead = {
+        Resource.CPU: part_load[:, PartMetric.CPU_LEADER],
+        Resource.NW_IN: part_load[:, PartMetric.NW_IN_LEADER],
+        Resource.NW_OUT: part_load[:, PartMetric.NW_OUT_LEADER],
+        Resource.DISK: part_load[:, PartMetric.DISK],
+    }[Resource(res)]
+    foll = {
+        Resource.CPU: part_load[:, PartMetric.CPU_FOLLOWER],
+        Resource.NW_IN: part_load[:, PartMetric.NW_IN_FOLLOWER],
+        Resource.NW_OUT: jnp.zeros_like(lead),
+        Resource.DISK: part_load[:, PartMetric.DISK],
+    }[Resource(res)]
+    r = assignment.shape[1]
+    is_leader = (jnp.arange(r) == 0)[None, :]
+    return jnp.where(is_leader, lead[:, None], foll[:, None])
+
+
 def make_move_batch(
     part_load: jax.Array,
     assignment: jax.Array,

@@ -81,6 +81,43 @@ class Goal:
         util = utilization(agg, static)
         return -jnp.max(util, axis=1)
 
+    # -- drain/fill round hooks (analyzer.drain) --------------------------------
+    # The batched engine runs every goal as a drain/fill round (the reference's
+    # rebalanceForBroker structure, vectorized); these three hooks tell it
+    # which brokers to drain, which replicas to drain first, and where to
+    # send them. Validation stays exact (acceptance/action_score), so the
+    # hooks only shape the candidate set, never the semantics.
+
+    def src_rank(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
+        """f32[B]: source priority for the drain round (-inf = not a source).
+
+        Default: overall utilization — the most loaded brokers drain first,
+        which both fixes over-bounds brokers and feeds under-loaded ones."""
+        util = utilization(agg, static)
+        return jnp.where(static.alive, jnp.max(util, axis=1), -jnp.inf)
+
+    def drain_contrib(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
+        """f32[P, R]: per-replica drain priority on its current broker
+        (higher drains first; -inf excludes the replica from this goal's
+        candidate lists). Default: total load carried by the slot."""
+        from cruise_control_tpu.analyzer.actions import _follower_vec, _leader_vec
+
+        lead = jnp.sum(_leader_vec(static.part_load, jnp.arange(
+            static.part_load.shape[0], dtype=jnp.int32)), axis=-1)
+        foll = jnp.sum(_follower_vec(static.part_load, jnp.arange(
+            static.part_load.shape[0], dtype=jnp.int32)), axis=-1)
+        r = agg.assignment.shape[1]
+        is_leader = (jnp.arange(r) == 0)[None, :]
+        return jnp.where(is_leader, lead[:, None], foll[:, None])
+
+    def dst_candidates(self, static: StaticCtx, gs, agg: Aggregates, tables,
+                       cand_p: jax.Array, cand_s: jax.Array,
+                       cold: jax.Array) -> jax.Array:
+        """Destinations for each drained candidate: i32[C] (one global list,
+        the default) or i32[V, K, C] (per-candidate — e.g. the under-count
+        brokers of the candidate's own topic)."""
+        return cold
+
     def __repr__(self) -> str:  # goals are stateless singletons
         return self.name
 

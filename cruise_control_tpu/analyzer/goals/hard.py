@@ -68,6 +68,23 @@ class RackAwareGoal(Goal):
         tiebreak = 1e-3 * (1.0 - jnp.tanh(jnp.max(util, axis=1)))[act.dst]
         return jnp.where(is_move & dup, 1.0 + tiebreak, 0.0)
 
+    def src_rank(self, static, gs, agg):
+        slot_viol = self._slot_violation(static, agg)
+        b = static.alive.shape[0]
+        seg = jnp.where(agg.assignment >= 0, agg.assignment, b).reshape(-1)
+        nviol = jax.ops.segment_sum(
+            slot_viol.reshape(-1).astype(jnp.float32), seg, num_segments=b + 1
+        )[:b]
+        return jnp.where(static.alive & (nviol > 0), nviol, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        # only rack-violating replicas are candidates; cheapest moves first
+        from cruise_control_tpu.common.resources import PartMetric
+
+        disk = static.part_load[:, PartMetric.DISK]
+        viol = self._slot_violation(static, agg)
+        return jnp.where(viol, 1.0 - 1e-9 * disk[:, None], -jnp.inf)
+
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(rack_enabled=jnp.asarray(True))
 
@@ -102,6 +119,18 @@ class ReplicaCapacityGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
+
+    def src_rank(self, static, gs, agg):
+        over = (agg.replica_count - static.max_replicas_per_broker).astype(
+            jnp.float32
+        )
+        return jnp.where(static.alive & (over > 0), over, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        from cruise_control_tpu.common.resources import PartMetric
+
+        disk = static.part_load[:, PartMetric.DISK]
+        return jnp.broadcast_to(-disk[:, None], agg.assignment.shape)
 
     def contribute_acceptance(self, static, gs, tables):
         cap = static.max_replicas_per_broker.astype(jnp.float32)
@@ -171,6 +200,23 @@ class CapacityGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return gs.limit - agg.broker_load[:, self.resource]
+
+    def src_rank(self, static, gs, agg):
+        excess = agg.broker_load[:, self.resource] - gs.limit
+        over = excess > 0.0
+        if self.resource == Resource.CPU:
+            host_over = agg.host_cpu_load > static.host_cpu_capacity_limit
+            over = over | host_over[static.broker_host]
+            excess = jnp.maximum(
+                excess, (agg.host_cpu_load - static.host_cpu_capacity_limit)[
+                    static.broker_host]
+            )
+        return jnp.where(static.alive & over, excess, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        from cruise_control_tpu.analyzer.actions import slot_contrib
+
+        return slot_contrib(static.part_load, agg.assignment, self.resource)
 
     def contribute_acceptance(self, static, gs, tables):
         hi = tables.hi_load.at[:, self.resource].min(gs.limit)

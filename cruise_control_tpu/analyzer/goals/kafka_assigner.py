@@ -75,6 +75,22 @@ class KafkaAssignerEvenRackAwareGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
 
+    def src_rank(self, static, gs, agg):
+        # sources: brokers with rack violations or above the even window
+        rack_rank = self._rack.src_rank(static, None, agg)
+        c = agg.replica_count.astype(jnp.float32)
+        over = jnp.where(static.alive & (c > gs.upper), c - gs.upper, -jnp.inf)
+        return jnp.maximum(jnp.where(jnp.isfinite(rack_rank), rack_rank + 1e3, -jnp.inf), over)
+
+    def drain_contrib(self, static, gs, agg):
+        # rack-violating replicas first, then any replica (cheapest first)
+        from cruise_control_tpu.common.resources import PartMetric
+
+        disk = static.part_load[:, PartMetric.DISK]
+        viol = self._rack._slot_violation(static, agg)
+        base = jnp.broadcast_to(-disk[:, None], agg.assignment.shape)
+        return jnp.where(viol, 1.0 - 1e-9 * disk[:, None], base)
+
     def contribute_acceptance(self, static, gs, tables):
         tables = self._rack.contribute_acceptance(static, None, tables)
         # strict evenness caps dst only (no src lower bound in acceptance)

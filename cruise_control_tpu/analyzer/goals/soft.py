@@ -123,6 +123,14 @@ class ResourceDistributionGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -self._util(static, agg)
 
+    def src_rank(self, static, gs, agg):
+        return jnp.where(static.alive & gs.active, self._util(static, agg), -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        from cruise_control_tpu.analyzer.actions import slot_contrib
+
+        return slot_contrib(static.part_load, agg.assignment, self.resource)
+
     def contribute_acceptance(self, static, gs, tables):
         # balance-band bounds, enforced with the two-case semantics
         # (acceptance.band_move_acceptance) rather than as a hard box; in
@@ -181,6 +189,18 @@ class ReplicaDistributionGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -agg.replica_count.astype(jnp.float32)
 
+    def src_rank(self, static, gs, agg):
+        return jnp.where(
+            static.alive, agg.replica_count.astype(jnp.float32), -jnp.inf
+        )
+
+    def drain_contrib(self, static, gs, agg):
+        # any replica rebalances the count; prefer the cheapest to move
+        from cruise_control_tpu.common.resources import PartMetric
+
+        disk = static.part_load[:, PartMetric.DISK]
+        return jnp.broadcast_to(-disk[:, None], agg.assignment.shape)
+
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(
             hi_rep=jnp.minimum(tables.hi_rep, gs.upper),
@@ -230,6 +250,22 @@ class LeaderReplicaDistributionGoal(Goal):
     def dst_preference(self, static, gs, agg):
         return -agg.leader_count.astype(jnp.float32)
 
+    def src_rank(self, static, gs, agg):
+        return jnp.where(
+            static.alive, agg.leader_count.astype(jnp.float32), -jnp.inf
+        )
+
+    def drain_contrib(self, static, gs, agg):
+        # only leader replicas shift leader counts: moving one (or promoting
+        # one of its followers via the leadership family) rebalances; the
+        # disk tiebreak prefers the cheapest physical move
+        from cruise_control_tpu.common.resources import PartMetric
+
+        disk = static.part_load[:, PartMetric.DISK]
+        r = agg.assignment.shape[1]
+        is_leader = (jnp.arange(r) == 0)[None, :]
+        return jnp.where(is_leader, 1.0 - 1e-9 * disk[:, None], -jnp.inf)
+
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(
             hi_lead=jnp.minimum(tables.hi_lead, gs.upper),
@@ -247,6 +283,12 @@ class TopicReplicaDistributionGoal(Goal):
     (cc/analyzer/goals/TopicReplicaDistributionGoal.java:53)."""
 
     name = "TopicReplicaDistributionGoal"
+    #: batched engine: drain (topic, broker) surplus pairs with an exact
+    #: all-broker destination scan (analyzer.drain.make_pair_drain_round) —
+    #: per-broker replica picks starve this goal (a broker's top candidates
+    #: are mostly replicas of the same over topic) and pruned destination
+    #: lists miss the rare topic-feasible AND band-feasible destination
+    pair_drain = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
@@ -283,6 +325,24 @@ class TopicReplicaDistributionGoal(Goal):
             tiebreak=(c_src - c_dst) * 1e-2,
         )
         return jnp.where(is_move, score, 0.0)
+
+    def src_rank(self, static, gs, agg):
+        c = agg.topic_replica_count.astype(jnp.float32)  # [T, B]
+        excess = jnp.sum(jnp.maximum(0.0, c - gs.upper[:, None]), axis=0)
+        return jnp.where(static.alive & (excess > 0.0), excess, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        # a replica's priority = how over-count its (topic, broker) pair is;
+        # replicas of topics already within bounds on their broker are not
+        # drain candidates for this goal
+        from cruise_control_tpu.common.resources import PartMetric
+
+        t = static.topic_id  # [P]
+        b = jnp.where(agg.assignment >= 0, agg.assignment, 0)  # [P, R]
+        cnt = agg.topic_replica_count[t[:, None], b].astype(jnp.float32)
+        over = cnt - gs.upper[t][:, None]
+        disk = static.part_load[:, PartMetric.DISK]
+        return jnp.where(over > 0.0, over - 1e-9 * disk[:, None], -jnp.inf)
 
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(
@@ -324,6 +384,18 @@ class PotentialNwOutGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return self._limit(static) - agg.potential_nw_out
+
+    def src_rank(self, static, gs, agg):
+        excess = agg.potential_nw_out - self._limit(static)
+        return jnp.where(static.alive & (excess > 0.0), excess, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        # every replica contributes its partition's leader NW_OUT to the
+        # broker's potential outbound load, leaders and followers alike
+        from cruise_control_tpu.common.resources import PartMetric
+
+        pnw = static.part_load[:, PartMetric.NW_OUT_LEADER]
+        return jnp.broadcast_to(pnw[:, None], agg.assignment.shape)
 
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(hi_pnw=jnp.minimum(tables.hi_pnw, self._limit(static)))
@@ -369,6 +441,20 @@ class LeaderBytesInDistributionGoal(Goal):
 
     def dst_preference(self, static, gs, agg):
         return -agg.leader_nw_in
+
+    def src_rank(self, static, gs, agg):
+        over = agg.leader_nw_in > gs.upper
+        return jnp.where(static.alive & over, agg.leader_nw_in, -jnp.inf)
+
+    def drain_contrib(self, static, gs, agg):
+        # only leadership carries leader bytes-in: drain the hottest leader
+        # replicas (moving one, or promoting a follower, sheds its NW_IN)
+        from cruise_control_tpu.common.resources import PartMetric
+
+        nw_in = static.part_load[:, PartMetric.NW_IN_LEADER]
+        r = agg.assignment.shape[1]
+        is_leader = (jnp.arange(r) == 0)[None, :]
+        return jnp.where(is_leader, nw_in[:, None], -jnp.inf)
 
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(

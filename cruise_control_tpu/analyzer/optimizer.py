@@ -130,6 +130,11 @@ class OptimizerSettings:
     #: (each applied action is valid at application time; see
     #: context.apply_actions_batch)
     apply_waves: int = 8
+    #: drain/fill round widths (analyzer.drain, the batched-mode engine):
+    #: top-V source brokers x top-K drain candidates each x C destinations
+    drain_src: int = 512
+    drain_per_broker: int = 8
+    drain_dst: int = 64
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -141,6 +146,9 @@ class OptimizerSettings:
             swap_candidates=config.get_int("optimizer.swap.candidate.replicas"),
             chunk_rounds=config.get_int("optimizer.chunk.rounds"),
             apply_waves=config.get_int("optimizer.apply.waves"),
+            drain_src=config.get_int("optimizer.drain.source.brokers"),
+            drain_per_broker=config.get_int("optimizer.drain.candidates.per.broker"),
+            drain_dst=config.get_int("optimizer.drain.destination.brokers"),
         )
 
 
@@ -310,12 +318,22 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             agg_c, applied_any, done = wave_with_dst(agg_c, applied_any, done, fresh_dst)
             return (agg_c, applied_any, done), None
 
-        carry, _ = jax.lax.scan(
-            wave,
-            (agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)),
-            jnp.arange(n_waves, dtype=jnp.int32),
-        )
-        agg2, applied_any, done = carry
+        if k_sel == 1 and goal.uses_moves:
+            # faithful-greedy mode: rank-paired destinations could apply the
+            # first preference-ranked destination that validates, pre-empting
+            # the precision wave's argmax when the goal score is not fully
+            # separable — the precision wave below IS the reference's full
+            # eligible-destination scan, so it alone runs
+            agg2, applied_any, done = (
+                agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)
+            )
+        else:
+            carry, _ = jax.lax.scan(
+                wave,
+                (agg, jnp.asarray(False), jnp.zeros((k_sel,), dtype=bool)),
+                jnp.arange(n_waves, dtype=jnp.int32),
+            )
+            agg2, applied_any, done = carry
         if goal.uses_moves:
             # precision wave: rank-pairing tries `n_waves` destinations per
             # entry per round, which is plenty mid-run but can miss the ONE
@@ -338,21 +356,38 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             agg2, applied_any, done = wave_with_dst(agg2, applied_any, done, fresh_dst)
         return agg2, applied_any
 
+    # batched mode runs EVERY goal as a drain/fill round (analyzer.drain):
+    # per-round cost scales with the violated set, not the partition count.
+    # Greedy parity mode (batch_k=1) keeps the exhaustive [P, R, K] grid +
+    # full-destination precision wave for non-swap goals — the
+    # stronger-than-reference baseline — while resource-distribution goals
+    # use the same drain kernel in both modes (run to deeper convergence in
+    # greedy mode), as the bench always has.
+    use_drain = settings.batch_k > 1 or getattr(goal, "uses_swaps", False)
+    drain_fn = None
     swap_fn = None
-    dist_fn = None
-    if getattr(goal, "uses_swaps", False):
-        from cruise_control_tpu.analyzer.swaps import (
-            make_distribution_round,
-            make_swap_round,
+    if use_drain:
+        from cruise_control_tpu.analyzer.drain import (
+            make_drain_round,
+            make_pair_drain_round,
         )
+
+        if getattr(goal, "pair_drain", False):
+            drain_fn = make_pair_drain_round(
+                goal, dims, settings.drain_src, settings.apply_waves
+            )
+        else:
+            drain_fn = make_drain_round(
+                goal, dims, settings.drain_src, settings.drain_per_broker,
+                settings.drain_dst, settings.apply_waves,
+            )
+    if getattr(goal, "uses_swaps", False):
+        from cruise_control_tpu.analyzer.swaps import make_swap_round
 
         # hot/cold set width scales with broker count: selection staleness
         # within a round only hurts when the hot set is a large fraction of
         # the cluster (a 32-of-100 hot set measurably degraded quality; at
-        # 2,600 brokers a 128-wide set is 5% of the cluster). Wave apply made
-        # wide sets cheap — sequential depth per round is `apply_waves`
-        # regardless of width — and every extra hot broker is another drain
-        # source per round, which is what the <10s config-5 target is made of.
+        # 2,600 brokers a 128-wide set is 5% of the cluster).
         adaptive = max(
             settings.num_swap_pairs, min(128, dims.num_brokers // 16)
         )
@@ -360,57 +395,64 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             goal, (), dims, adaptive, settings.swap_candidates,
             settings.swaps_per_broker, apply_waves=settings.apply_waves,
         )
-        # resource-distribution goals replace the global [P, R, K] shortlist
-        # with the reference-shaped drain/fill round: per-broker steepest
-        # descent keeps near-greedy action quality (the global top-k shortlist
-        # measurably degrades the reachable optimum as batch_k grows) and its
-        # grid cost is independent of P
-        dist_fn = make_distribution_round(
-            goal, dims,
-            n_hot=max(16, adaptive),
-            k_rep=max(16, settings.swap_candidates),
-            j_apply=settings.swaps_per_broker,
-            k_dst=k_dst,
-            apply_waves=settings.apply_waves,
-        )
 
-    def goal_loop(static: StaticCtx, agg: Aggregates, tables, budget=None):
-        """Run rounds until convergence or `budget` rounds (dynamic scalar;
-        defaults to the static per-goal cap). Returns (agg, rounds, stalled):
-        `stalled` means the goal converged — the last round applied nothing —
-        as opposed to merely running out of budget (the chunked executor's
-        resume signal)."""
+    # pair-drain rounds rotate through tie-ranked surplus slices, so one
+    # empty round only proves one SLICE is blocked; several consecutive empty
+    # rounds (covering different rotations) are required to call it converged
+    empties_to_stall = 8 if getattr(goal, "pair_drain", False) else 1
+
+    def goal_loop(static: StaticCtx, agg: Aggregates, tables, budget=None,
+                  rnd_base=None, empties0=None):
+        """Run rounds until convergence or `budget` MORE rounds (dynamic
+        scalar; defaults to the static per-goal cap). `rnd_base`/`empties0`
+        resume a goal paused at a chunk boundary: the round index seeds the
+        pair-drain rotation (restarting it at 0 every device call would
+        replay the same surplus slices and never reach the rest), and the
+        carried empty-round streak keeps the multi-round stall detection
+        correct across calls. Returns (agg, rounds, empties): `empties >=
+        empties_to_stall` means the goal converged, as opposed to merely
+        running out of budget (the chunked executor's resume signal)."""
         gs0 = goal.prepare(static, agg, dims)
         if budget is None:
             budget = jnp.int32(settings.max_rounds_per_goal)
+        if rnd_base is None:
+            rnd_base = jnp.int32(0)
+        if empties0 is None:
+            empties0 = jnp.int32(0)
 
         def cond(c):
-            _, rnd, done = c
-            return (rnd < budget) & ~done
+            _, rnd, empties = c
+            return (rnd - rnd_base < budget) & (empties < empties_to_stall)
 
         def body(c):
-            agg_c, rnd, _ = c
-            if dist_fn is not None:
-                agg2, applied = dist_fn(static, agg_c, tables, gs0)
+            agg_c, rnd, empties = c
+            if drain_fn is not None:
+                # the goal's per-replica drain priority, shared by the drain
+                # round and (on stall) the swap search
+                contrib = goal.drain_contrib(static, gs0, agg_c)
+                agg2, applied = drain_fn(static, agg_c, tables, gs0, contrib, rnd)
             else:
                 agg2, applied = one_round(static, agg_c, tables)
             if swap_fn is not None:
                 # swaps only when plain moves stalled, matching the
-                # reference's move-first-then-swap order
+                # reference's move-first-then-swap order; `contrib` is from
+                # agg_c, which on the stall path equals agg2
                 agg2, swap_applied = jax.lax.cond(
                     applied,
                     lambda a: (a, jnp.asarray(False)),
-                    lambda a: swap_fn(static, a, tables),
+                    lambda a: swap_fn(static, a, tables, contrib),
                     agg2,
                 )
                 applied = applied | swap_applied
-            return (agg2, rnd + 1, ~applied)
+            empties = jnp.where(applied, jnp.int32(0), empties + 1)
+            return (agg2, rnd + 1, empties)
 
-        final_agg, rounds, stalled = jax.lax.while_loop(
-            cond, body, (agg, jnp.int32(0), jnp.asarray(False))
+        final_agg, rnd_end, empties = jax.lax.while_loop(
+            cond, body, (agg, rnd_base, empties0)
         )
-        return final_agg, rounds, stalled
+        return final_agg, rnd_end - rnd_base, empties
 
+    goal_loop.empties_to_stall = empties_to_stall
     return goal_loop
 
 
@@ -483,51 +525,125 @@ def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
 
 
 def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
-    """Bounded-duration executor: ONE jitted program that runs ONE goal
-    (dynamic `goal_idx` via lax.switch) for at most `budget` rounds.
+    """Bounded-duration executor: ONE jitted program that advances the
+    priority stack by up to `budget` rounds per device call, CROSSING goal
+    boundaries inside the call.
 
     The fused stack (_make_stack_step) executes the whole priority loop as a
     single device call; at north-star scale (2,600 brokers / 200k partitions)
     that call runs for minutes, longer than remote-TPU transports tolerate.
     This machine carries the same state — aggregates + merged acceptance
-    tables — across many short calls instead: the host sequences goals and
-    round chunks, each call bounded by `budget` rounds, with identical
-    semantics (goal thresholds are derived from move-invariant totals, so
-    recomputing them per chunk equals the reference's one initGoalState per
-    goal.optimize, AbstractGoal.java:67).
+    tables + (goal_idx, rounds_in_goal) cursor + per-goal metrics — across a
+    few bounded calls instead, with identical semantics (goal thresholds are
+    derived from move-invariant totals, so recomputing them per chunk equals
+    the reference's one initGoalState per goal.optimize,
+    AbstractGoal.java:67). Crossing goal boundaries matters for dispatch
+    overhead: a per-goal call floor costs |goals| transport round-trips even
+    when most goals stall after one round; here the whole stack needs
+    ~total_rounds/budget calls.
 
-    Returns machine(static, agg, tables, goal_idx, budget) ->
-      (agg2, tables2, rounds, stalled, viol_in, cost_in, viol_out, cost_out)
-    where tables2 already includes this goal's contribution — the host uses
-    tables2 once it deems the goal complete (stalled, or per-goal round cap
-    reached) and keeps tables otherwise. Compile cost matches the fused
-    stack: all goal bodies are traced once into the one switch program.
+    Returns machine(static, agg, tables, goal_idx, rounds_in_goal,
+    empties_in_goal, metrics, budget) -> (agg2, tables2, goal_idx2,
+    rounds_in_goal2, empties_in_goal2, metrics2, spent) where `metrics` is a
+    StackMetrics of [G] arrays updated in place (entry stats written when a
+    goal starts, exit stats whenever it pauses or completes) and `spent` is
+    the number of rounds executed. The (goal_idx, rounds_in_goal,
+    empties_in_goal) cursor makes a paused goal resume EXACTLY where it left
+    off: the round index seeds the pair-drain rotation and the empty-round
+    streak continues counting toward the multi-round stall threshold. The
+    stack is finished when goal_idx2 == len(goal_names). Compile cost matches
+    the fused stack: all goal bodies are traced once into the one switch
+    program.
     """
     from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
 
     goals = [GOAL_REGISTRY[n] for n in goal_names]
     loops = [_make_goal_loop(g, dims, settings) for g in goals]
+    n_goals = len(goals)
+    cap = settings.max_rounds_per_goal
 
-    def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx, budget):
+    def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx,
+                rounds_in_goal, empties_in_goal, metrics: StackMetrics, budget):
         def make_branch(goal, loop):
-            def branch(operands):
-                static_b, agg_b, tables_b, budget_b = operands
-                gs_in = goal.prepare(static_b, agg_b, dims)
-                viol_in = jnp.sum(goal.broker_violation(static_b, gs_in, agg_b)).astype(jnp.int32)
-                cost_in = goal.cost(static_b, gs_in, agg_b).astype(jnp.float32)
-                agg2, rounds, stalled = loop(static_b, agg_b, tables_b, budget_b)
-                gs_out = goal.prepare(static_b, agg2, dims)
-                viol_out = jnp.sum(goal.broker_violation(static_b, gs_out, agg2)).astype(jnp.int32)
-                cost_out = goal.cost(static_b, gs_out, agg2).astype(jnp.float32)
-                tables2 = goal.contribute_acceptance(static_b, gs_out, tables_b)
-                return agg2, tables2, rounds, stalled, viol_in, cost_in, viol_out, cost_out
+            def branch(op):
+                agg_b, tables_b, gi, rig, emp, metrics_b, left = op
+                gs_in = goal.prepare(static, agg_b, dims)
+                viol_in = jnp.sum(
+                    goal.broker_violation(static, gs_in, agg_b)
+                ).astype(jnp.int32)
+                cost_in = goal.cost(static, gs_in, agg_b).astype(jnp.float32)
+                first = rig == 0
+                metrics_b = metrics_b._replace(
+                    violated_before=jnp.where(
+                        first,
+                        metrics_b.violated_before.at[gi].set(viol_in),
+                        metrics_b.violated_before,
+                    ),
+                    cost_before=jnp.where(
+                        first,
+                        metrics_b.cost_before.at[gi].set(cost_in),
+                        metrics_b.cost_before,
+                    ),
+                )
+                budget_g = jnp.minimum(left, cap - rig)
+                agg2, rounds, emp2 = loop(
+                    static, agg_b, tables_b, budget_g,
+                    rnd_base=rig, empties0=emp,
+                )
+                rig2 = rig + rounds
+                stalled = emp2 >= loop.empties_to_stall
+                done_goal = stalled | (rig2 >= cap)
+                gs_out = goal.prepare(static, agg2, dims)
+                viol_out = jnp.sum(
+                    goal.broker_violation(static, gs_out, agg2)
+                ).astype(jnp.int32)
+                cost_out = goal.cost(static, gs_out, agg2).astype(jnp.float32)
+                tables_done = goal.contribute_acceptance(static, gs_out, tables_b)
+                tables2 = jax.tree.map(
+                    lambda a, b: jnp.where(done_goal, a, b), tables_done, tables_b
+                )
+                metrics_b = metrics_b._replace(
+                    violated_after=metrics_b.violated_after.at[gi].set(viol_out),
+                    cost_after=metrics_b.cost_after.at[gi].set(cost_out),
+                    rounds=metrics_b.rounds.at[gi].set(rig2),
+                )
+                gi2 = jnp.where(done_goal, gi + 1, gi)
+                rig2 = jnp.where(done_goal, jnp.int32(0), rig2)
+                emp2 = jnp.where(done_goal, jnp.int32(0), emp2)
+                return agg2, tables2, gi2, rig2, emp2, metrics_b, left - rounds
 
             return branch
 
         branches = [make_branch(g, l) for g, l in zip(goals, loops)]
-        return jax.lax.switch(goal_idx, branches, (static, agg, tables, budget))
+
+        def cond(c):
+            _, _, gi, _, _, _, left = c
+            return (left > 0) & (gi < n_goals)
+
+        def body(c):
+            agg_c, tables_c, gi, rig, emp, metrics_c, left = c
+            return jax.lax.switch(
+                jnp.minimum(gi, n_goals - 1), branches,
+                (agg_c, tables_c, gi, rig, emp, metrics_c, left),
+            )
+
+        agg2, tables2, gi2, rig2, emp2, metrics2, left2 = jax.lax.while_loop(
+            cond, body,
+            (agg, tables, goal_idx, rounds_in_goal, empties_in_goal, metrics, budget),
+        )
+        return agg2, tables2, gi2, rig2, emp2, metrics2, budget - left2
 
     return jax.jit(machine)
+
+
+def empty_stack_metrics(n_goals: int) -> StackMetrics:
+    return StackMetrics(
+        violated_before=jnp.zeros((n_goals,), jnp.int32),
+        violated_after=jnp.zeros((n_goals,), jnp.int32),
+        cost_before=jnp.zeros((n_goals,), jnp.float32),
+        cost_after=jnp.zeros((n_goals,), jnp.float32),
+        rounds=jnp.zeros((n_goals,), jnp.int32),
+    )
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
@@ -607,7 +723,8 @@ def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
     return _compile_cached(
         key, tag, dims,
         lambda: _cached_goal_machine(goal_names, dims, settings).lower(
-            static, agg, tables, jnp.int32(0), jnp.int32(1)
+            static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+            empty_stack_metrics(len(goal_names)), jnp.int32(1)
         ),
     )
 
@@ -701,69 +818,67 @@ class GoalOptimizer:
         self._mesh = mesh
 
     def _run_chunked(self, goal_names: Tuple[str, ...], dims: Dims, static, agg):
-        """Drive the goal machine: sequence goals on the host, each executed
-        as chunks of at most `chunk_rounds` rounds per device call.
+        """Drive the goal machine: repeated bounded device calls, each
+        advancing the stack by up to `chunk` rounds (crossing goal boundaries
+        inside the call — see _make_goal_machine).
 
-        Exactly one host sync per chunk (the rounds/stalled/stats read);
-        a 715-round north-star run at chunk 16 costs ~45 syncs, microseconds
-        each — while no single device call can outlive the transport."""
+        Exactly one host sync per call (the cursor/rounds read); the per-call
+        budget adapts to the measured round rate so small problems coalesce
+        into a couple of large calls while north-star problems stay under the
+        remote-TPU transport deadline."""
         from cruise_control_tpu.analyzer.acceptance import empty_tables as _empty
 
         tables = _empty(dims)
+        metrics = empty_stack_metrics(len(goal_names))
         if self._mesh is not None:
             from cruise_control_tpu.parallel.sharding import place_replicated
 
             tables = place_replicated(tables, self._mesh)
+            metrics = place_replicated(metrics, self._mesh)
         machine = _machine_executable(
             goal_names, dims, self._settings, self._mesh, static, agg, tables
         )
         n = len(goal_names)
-        vb = np.zeros(n, np.int32)
-        va = np.zeros(n, np.int32)
-        cb = np.zeros(n, np.float32)
-        ca = np.zeros(n, np.float32)
-        rs = np.zeros(n, np.int32)
-        durs = np.zeros(n, np.float64)
-        cap = self._settings.max_rounds_per_goal
+        gi = jnp.int32(0)
+        rig = jnp.int32(0)
+        emp = jnp.int32(0)
+        chunk = self._settings.chunk_rounds
         target_s = self._settings.chunk_target_s
+        durs = np.zeros(n, np.float64)
+        rounds_seen = np.zeros(n, np.int64)
+        last_gi = 0
         t_stack = time.monotonic()
-        for i in range(n):
-            t_goal = time.monotonic()
-            total = 0
-            first = True
-            # per-goal round cost is near-constant but differs up to ~10x
-            # across goals: adapt within the goal, reset at each boundary
-            chunk = self._settings.chunk_rounds
-            while True:
-                budget = min(chunk, cap - total)
-                t_call = time.monotonic()
-                agg, tables2, rounds, stalled, vi, ci, vo, co = machine(
-                    static, agg, tables, jnp.int32(i), jnp.int32(max(1, budget))
-                )
-                rounds_h, stalled_h, vi_h, ci_h, vo_h, co_h = jax.device_get(
-                    (rounds, stalled, vi, ci, vo, co)
-                )
-                call_s = time.monotonic() - t_call
-                if int(rounds_h) > 0 and call_s > 0:
-                    # adapt the per-call budget to the measured round rate:
-                    # small problems coalesce into few large calls, the
-                    # north-star scale stays under the transport deadline
-                    rate = int(rounds_h) / call_s
-                    chunk = max(1, min(4096, int(rate * target_s)))
-                if first:
-                    vb[i], cb[i] = int(vi_h), float(ci_h)
-                    first = False
-                total += int(rounds_h)
-                if bool(stalled_h) or total >= cap:
-                    va[i], ca[i] = int(vo_h), float(co_h)
-                    rs[i] = total
-                    tables = tables2
-                    break
-            durs[i] = time.monotonic() - t_goal
-        metrics = StackMetrics(
-            violated_before=vb, violated_after=va, cost_before=cb,
-            cost_after=ca, rounds=rs,
-        )
+        while True:
+            t_call = time.monotonic()
+            agg, tables, gi, rig, emp, metrics, spent = machine(
+                static, agg, tables, gi, rig, emp, metrics,
+                jnp.int32(max(1, chunk)),
+            )
+            gi_h, spent_h, rounds_h = jax.device_get((gi, spent, metrics.rounds))
+            call_s = time.monotonic() - t_call
+            # attribute this call's wall-clock to goals by their round share
+            delta = np.maximum(rounds_h.astype(np.int64) - rounds_seen, 0)
+            if delta.sum() > 0:
+                durs += call_s * delta / delta.sum()
+            rounds_seen = np.maximum(rounds_seen, rounds_h.astype(np.int64))
+            if int(gi_h) >= n:
+                break
+            if int(gi_h) != last_gi:
+                # goal boundary crossed: per-round cost differs up to ~10x
+                # across goals, so a budget tuned on the previous goal's rate
+                # could overshoot the transport deadline inside the next one;
+                # fall back to the configured chunk and re-learn
+                chunk = self._settings.chunk_rounds
+                last_gi = int(gi_h)
+            elif int(spent_h) > 0 and call_s > 0:
+                # adapt the per-call budget to the measured round rate:
+                # small problems coalesce into few large calls, the
+                # north-star scale stays under the transport deadline. Growth
+                # is capped at 8x per call so one cheap-goal measurement
+                # cannot balloon the budget right before an expensive goal.
+                rate = int(spent_h) / call_s
+                chunk = max(1, min(4096, int(rate * target_s), chunk * 8))
+        metrics = jax.device_get(metrics)
         return agg, metrics, time.monotonic() - t_stack, durs
 
     def _prepare(
@@ -860,8 +975,11 @@ class GoalOptimizer:
             machine = _machine_executable(
                 goal_names_t, dims, self._settings, self._mesh, static, agg, tables
             )
-            out = machine(static, agg, tables, jnp.int32(0), jnp.int32(1))
-            jax.block_until_ready(out[3])
+            out = machine(
+                static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
+                empty_stack_metrics(len(goal_names_t)), jnp.int32(1),
+            )
+            jax.block_until_ready(out[6])
         else:
             step = _stack_executable(
                 goal_names_t, dims, self._settings, self._mesh, static, agg

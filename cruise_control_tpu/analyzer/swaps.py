@@ -44,44 +44,36 @@ from cruise_control_tpu.analyzer.goals.base import SCORE_EPS
 from cruise_control_tpu.common.resources import PartMetric, Resource
 
 
+from cruise_control_tpu.analyzer.actions import slot_contrib
+
+
 def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Array:
     """f32[P, R]: per-slot load contribution for one resource."""
-    pl = static.part_load
-    lead = {
-        Resource.CPU: pl[:, PartMetric.CPU_LEADER],
-        Resource.NW_IN: pl[:, PartMetric.NW_IN_LEADER],
-        Resource.NW_OUT: pl[:, PartMetric.NW_OUT_LEADER],
-        Resource.DISK: pl[:, PartMetric.DISK],
-    }[Resource(res)]
-    foll = {
-        Resource.CPU: pl[:, PartMetric.CPU_FOLLOWER],
-        Resource.NW_IN: pl[:, PartMetric.NW_IN_FOLLOWER],
-        Resource.NW_OUT: jnp.zeros_like(lead),
-        Resource.DISK: pl[:, PartMetric.DISK],
-    }[Resource(res)]
-    r = assignment.shape[1]
-    is_leader = (jnp.arange(r) == 0)[None, :]
-    return jnp.where(is_leader, lead[:, None], foll[:, None])
+    return slot_contrib(static.part_load, assignment, res)
 
 
 def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
                     swaps_per_broker: int = 4, apply_waves: int = 0):
-    """Build swap_round(static, agg, tables) -> (agg, applied_any) for a
-    resource-distribution goal (jit-compatible; call inside the goal loop).
+    """Build swap_round(static, agg, tables, runs) -> (agg, applied_any) for
+    a resource-distribution goal (jit-compatible; call inside the goal loop).
 
     `tables` are the merged acceptance bounds of the already-optimized goals
     (analyzer.acceptance): every candidate swap's NET effect must pass them,
-    the same invariant the move path enforces per candidate. Each round
-    applies up to `swaps_per_broker` swaps per hot broker (sequentially
-    re-validated) — in tight regimes where swaps are the only legal action,
-    per-round throughput decides how many rounds convergence takes."""
+    the same invariant the move path enforces per candidate. `runs` are the
+    round's shared sorted replica runs (analyzer.drain.replica_runs, built
+    with this goal's per-resource contribution): the heaviest replicas of a
+    hot broker are the head of its run, the lightest of a cold broker its
+    tail — one shared sort replaces per-broker top_k searches over the whole
+    replica axis."""
     res = goal.resource
     p_count, r = dims.num_partitions, dims.max_rf
     n_pairs = max(1, min(n_pairs, dims.num_brokers // 2 or 1))
     k = max(1, min(k, p_count))
     del priors  # prior-goal invariants arrive via the merged tables
 
-    def swap_round(static: StaticCtx, agg: Aggregates, tables):
+    def swap_round(static: StaticCtx, agg: Aggregates, tables, contrib_in):
+        from cruise_control_tpu.analyzer.drain import heavy_picks, light_picks
+
         gs = goal.prepare(static, agg, dims)
         cap = jnp.maximum(static.broker_capacity[:, res], 1e-9)
         util = agg.broker_load[:, res] / cap
@@ -91,8 +83,10 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         # immigrant-only self-healing mode (a swap moves non-immigrants).
         hot_rank = jnp.where(static.alive & static.replica_dst_ok, util, -jnp.inf)
         hot_vals, hot = jax.lax.top_k(hot_rank, n_pairs)  # i32[N]
+        hot = hot.astype(jnp.int32)
         cold_rank = jnp.where(static.alive & static.replica_dst_ok, -util, -jnp.inf)
         cold_vals, cold = jax.lax.top_k(cold_rank, n_pairs)  # i32[N]
+        cold = cold.astype(jnp.int32)
         # full hot x cold cross product [NH, NC, K, K]: rank-matched pairing
         # (hot_i only with cold_i) stalls as soon as a few extreme brokers
         # have no compatible exchange — under tight prior-goal bounds (e.g. a
@@ -106,21 +100,12 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         )
 
         contrib = _slot_contrib(static, agg.assignment, res)  # f32[P, R]
-        movable = static.movable_partition[:, None] & (agg.assignment >= 0)
 
-        def pick(broker, heaviest: bool):
-            mask = (agg.assignment == broker) & movable
-            score = jnp.where(mask, contrib, -jnp.inf if heaviest else jnp.inf)
-            flat = (score if heaviest else -score).reshape(p_count * r)
-            vals, idx = jax.lax.top_k(flat, k)
-            return (
-                (idx // r).astype(jnp.int32),  # partitions
-                (idx % r).astype(jnp.int32),  # slots
-                jnp.where(jnp.isfinite(vals), jnp.abs(vals), jnp.nan),  # loads
-            )
-
-        hp, hs, hl = jax.vmap(lambda b: pick(b, True))(hot)  # [N, K] each
-        cp, cs, cl = jax.vmap(lambda b: pick(b, False))(cold)
+        nb = static.broker_capacity.shape[0]
+        hp, hs, h_ok = heavy_picks(static, agg, contrib_in, hot, k, nb)  # [N, K]
+        hl = jnp.where(h_ok, contrib[hp, hs], jnp.nan)
+        cp, cs, c_ok = light_picks(static, agg, contrib_in, cold, k, nb)
+        cl = jnp.where(c_ok, contrib[cp, cs], jnp.nan)
 
         # [NH, NC, K, K] swap grid: replica a of hot_i <-> replica b of cold_j
         delta = hl[:, None, :, None] - cl[None, :, None, :]  # load moved hot -> cold
@@ -317,185 +302,6 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         return agg2, applied_any
 
     return swap_round
-
-
-def make_distribution_round(goal, dims, n_hot: int = 16, k_rep: int = 16,
-                            j_apply: int = 4, k_dst: int = 16,
-                            apply_waves: int = 0):
-    """Move phase for resource-distribution goals: the array form of
-    rebalanceByMovingLoadOut/-In (cc/analyzer/goals/ResourceDistributionGoal.java
-    :364,:699) — per hot broker, drain its heaviest replicas toward the
-    coldest brokers; fill under-loaded brokers from the richest.
-
-    The reference's AbstractGoal pass applies MANY actions per broker while
-    walking brokersToBalance (rebalanceForBroker), so applying the top-J
-    moves per hot broker under sequential re-validation is structurally the
-    reference loop, vectorized. Unlike the optimizer's global [P, R, K] grid
-    + top-k shortlist — which picks the k best *partitions* against stale
-    state and degrades the reachable optimum as k grows — this kernel's cost
-    is independent of P (top_k pulls per-broker replica lists), so rounds are
-    cheap enough to keep near-greedy action quality at full scale.
-    """
-    res = goal.resource
-    p_count, r = dims.num_partitions, dims.max_rf
-    n_hot = max(1, min(n_hot, dims.num_brokers))
-    n_cold = n_hot
-    k_rep = max(1, min(k_rep, p_count))
-    use_leadership = goal.uses_leadership and r >= 2
-    j_lead = max(4, j_apply)
-
-    def dist_round(static: StaticCtx, agg: Aggregates, tables, gs):
-        cap = jnp.maximum(static.broker_capacity[:, res], 1e-9)
-        util = agg.broker_load[:, res] / cap
-
-        # dead brokers outrank every live one as sources: evacuation comes
-        # first (GoalUtils.ensureNoReplicaOnDeadBrokers), and score_batch's
-        # DEAD_EVACUATION_BONUS makes their moves win the selection
-        hot_rank = jnp.where(static.dead, jnp.inf, util)
-        _, hot = jax.lax.top_k(hot_rank, n_hot)  # i32[V] sources (richest)
-        cold_rank = jnp.where(static.alive & static.replica_dst_ok, -util, -jnp.inf)
-        cold_ok, cold = jax.lax.top_k(cold_rank, n_cold)  # i32[V] receivers
-
-        contrib = _slot_contrib(static, agg.assignment, res)
-        movable = static.movable_partition[:, None] & (agg.assignment >= 0)
-
-        def pick_heavy(broker):
-            mask = (agg.assignment == broker) & movable
-            score = jnp.where(mask, contrib, -jnp.inf)
-            vals, idx = jax.lax.top_k(score.reshape(p_count * r), k_rep)
-            return (idx // r).astype(jnp.int32), (idx % r).astype(jnp.int32)
-
-        hp, hs = jax.vmap(pick_heavy)(hot)  # [V, K]
-
-        # move grid [V, K, C]: replica k of hot_i -> cold_j
-        full = (n_hot, k_rep, n_cold)
-        mv = build_selected(
-            static.part_load, agg.assignment,
-            jnp.broadcast_to(hp[:, :, None], full),
-            jnp.int32(KIND_MOVE),
-            jnp.broadcast_to(hs[:, :, None], full),
-            jnp.broadcast_to(cold[None, None, :], full),
-        )
-        from cruise_control_tpu.analyzer.acceptance import score_batch
-
-        s = score_batch(static, agg, mv, goal, gs, tables)
-        s = jnp.where(jnp.isfinite(cold_ok)[None, None, :], s, -jnp.inf)
-
-        # leadership family (CPU / NW_OUT shift util without moving data):
-        # global [P, R-1] grid, top-J overall
-        if use_leadership:
-            from cruise_control_tpu.analyzer.actions import make_leadership_batch
-
-            lb = make_leadership_batch(static.part_load, agg.assignment)
-            sl = score_batch(static, agg, lb, goal, gs, tables)
-            sl = jnp.broadcast_to(sl, (p_count, r - 1)).reshape(p_count * (r - 1))
-            lead_s, lead_i = jax.lax.top_k(sl, j_lead)
-            lead_p = (lead_i // (r - 1)).astype(jnp.int32)
-            lead_slot = (lead_i % (r - 1)).astype(jnp.int32) + 1
-            lead_kind = jnp.full((j_lead,), KIND_LEADERSHIP, dtype=jnp.int32)
-
-        # conflict-free apply waves (context.wave_select contract): per wave,
-        # every hot broker nominates its best remaining replica toward ONE
-        # cold broker — hot rank i paired with cold rank (i + wave) % C, the
-        # sorted-by-sorted matching. A per-hot argmax over ALL colds would
-        # send every hot broker to the same most-underloaded cold and the
-        # per-destination uniqueness would then admit one move per wave;
-        # rank-pairing keeps the full hot set moving in parallel, and the
-        # wave rotation retries failed pairs against other colds. Nominations
-        # are re-scored against the CURRENT aggregates and a broker-disjoint
-        # subset applies at once. Sequential depth per round: `waves`, vs the
-        # former n_hot*j_apply-long re-validated scan.
-        rows0 = jnp.arange(n_hot, dtype=jnp.int32)
-        kind_move = jnp.full((n_hot,), KIND_MOVE, dtype=jnp.int32)
-        waves = max(apply_waves, j_apply, 4)
-
-        def wave(carry, w):
-            agg_c, applied_any, cell_blk, rep_gone, lead_done = carry
-            blocked = cell_blk | rep_gone[:, :, None]
-            masked = jnp.where(blocked, -jnp.inf, s)
-            # rank-paired waves for throughput; the LAST wave argmaxes over
-            # the full (replica, cold) grid instead — precision for the tail,
-            # where the one legal pairing may not be the rotation's pick
-            # (grid argmax can collapse onto one cold broker, but as a final
-            # wave that still applies the single best remaining move)
-            def paired(masked):
-                c_i = ((rows0 + w) % n_cold).astype(jnp.int32)
-                col = jnp.take_along_axis(masked, c_i[:, None, None], axis=2)[:, :, 0]
-                a_i = jnp.argmax(col, axis=1).astype(jnp.int32)
-                return a_i, c_i, jnp.take_along_axis(col, a_i[:, None], axis=1)[:, 0]
-
-            def argmax_all(masked):
-                flat = masked.reshape(n_hot, k_rep * n_cold)
-                bi = jnp.argmax(flat, axis=1)
-                return (
-                    (bi // n_cold).astype(jnp.int32),
-                    (bi % n_cold).astype(jnp.int32),
-                    jnp.take_along_axis(flat, bi[:, None], axis=1)[:, 0],
-                )
-
-            a_idx, c_idx, bs = jax.lax.cond(
-                w == waves - 1, argmax_all, paired, masked
-            )
-            p_e = hp[rows0, a_idx]
-            slot_e = hs[rows0, a_idx]
-            dst_e = cold[c_idx]
-            act = build_selected(
-                static.part_load, agg_c.assignment, p_e, kind_move, slot_e, dst_e
-            )
-            s_now = score_batch(static, agg_c, act, goal, gs, tables)
-            ok = jnp.isfinite(bs) & jnp.isfinite(s_now)
-            all_p, all_kind, all_slot = p_e, kind_move, slot_e
-            all_dst, all_score, all_ok = dst_e, s_now, ok
-            if use_leadership:
-                l_dst = agg_c.assignment[lead_p, lead_slot]
-                lact = build_selected(
-                    static.part_load, agg_c.assignment, lead_p, lead_kind,
-                    lead_slot, l_dst,
-                )
-                ls_now = score_batch(static, agg_c, lact, goal, gs, tables)
-                lok = jnp.isfinite(lead_s) & jnp.isfinite(ls_now) & ~lead_done
-                all_p = jnp.concatenate([all_p, lead_p])
-                all_kind = jnp.concatenate([all_kind, lead_kind])
-                all_slot = jnp.concatenate([all_slot, lead_slot])
-                all_dst = jnp.concatenate([all_dst, l_dst])
-                all_score = jnp.concatenate([all_score, ls_now])
-                all_ok = jnp.concatenate([all_ok, lok])
-            all_act = build_selected(
-                static.part_load, agg_c.assignment, all_p, all_kind, all_slot, all_dst
-            )
-            sel = wave_select(
-                all_score, all_act.src, all_act.dst,
-                static.broker_host[all_act.dst], all_ok,
-                static.broker_capacity.shape[0], static.host_cpu_capacity_limit.shape[0],
-                parts=(all_p,), num_partitions=p_count,
-            )
-            agg_c = apply_actions_batch(static, agg_c, all_act, sel)
-            sel_mv = sel[:n_hot]
-            # a moved replica is gone from its hot broker; a nomination that
-            # failed re-scoring is a dead cell (retrying it would stall the
-            # argmax) — conflict losers stay available for the next wave
-            rep_gone = rep_gone.at[rows0, a_idx].set(rep_gone[rows0, a_idx] | sel_mv)
-            fail = jnp.isfinite(bs) & ~jnp.isfinite(s_now)
-            cell_blk = cell_blk.at[rows0, a_idx, c_idx].set(
-                cell_blk[rows0, a_idx, c_idx] | fail
-            )
-            if use_leadership:
-                lead_done = lead_done | sel[n_hot:]
-            return (agg_c, applied_any | jnp.any(sel), cell_blk, rep_gone, lead_done), None
-
-        init = (
-            agg,
-            jnp.asarray(False),
-            jnp.zeros((n_hot, k_rep, n_cold), dtype=bool),
-            jnp.zeros((n_hot, k_rep), dtype=bool),
-            jnp.zeros((j_lead,), dtype=bool),
-        )
-        (agg2, applied_any, _, _, _), _ = jax.lax.scan(
-            wave, init, jnp.arange(waves, dtype=jnp.int32)
-        )
-        return agg2, applied_any
-
-    return dist_round
 
 
 def _dist(u, gs):

@@ -104,6 +104,12 @@ def _config_def() -> ConfigDef:
              "duration for remote-TPU transports. 0 = single fused-stack call.")
     d.define("optimizer.apply.waves", Type.INT, 8, at_least(1), Importance.MEDIUM,
              "Conflict-free apply waves per round (sequential depth of the shortlist apply).")
+    d.define("optimizer.drain.source.brokers", Type.INT, 512, at_least(1), Importance.MEDIUM,
+             "Top-V source brokers per drain/fill round (batched mode).")
+    d.define("optimizer.drain.candidates.per.broker", Type.INT, 8, at_least(1), Importance.MEDIUM,
+             "Drain candidates pulled from each source broker's sorted run per round.")
+    d.define("optimizer.drain.destination.brokers", Type.INT, 64, at_least(1), Importance.MEDIUM,
+             "Destination candidates per drained replica (goal-aware lists).")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

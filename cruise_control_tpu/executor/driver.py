@@ -115,14 +115,17 @@ class ReassignmentJournalDriver(ClusterDriver):
     deletes) — the same write-then-watch contract as the ZK node, over a
     shared filesystem.
 
-    Execution ids restart at 0 in every process, so acks are only meaningful
-    within the driver instance that started the movement: construction sweeps
-    any ack files a previous (crashed/restarted) process left behind —
-    otherwise a stale `completed/0.json` would mark this process's first
-    movement finished before the controller ever saw it. Journal entries from
-    a previous run are intentionally KEPT: `has_ongoing_reassignment` reports
-    them and the executor refuses to start over them, mirroring the
-    reference's ongoing-reassignment guard (cc/executor/Executor.java:494)."""
+    Execution ids are epoch-seeded (ExecutionTaskPlanner starts at
+    time_ns//1000 and counts up), so ids never recur across processes and an
+    ack file is unambiguous evidence that its journal entry completed.
+    Construction RECONCILES rather than sweeps: journal entries whose ack
+    already exists are removed (their ack is consumed — the movement finished
+    while no driver was watching); journal entries without an ack are KEPT as
+    ongoing — `has_ongoing_reassignment` reports them and the executor
+    refuses to start over them, mirroring the reference's
+    ongoing-reassignment guard (cc/executor/Executor.java:494). Ack files
+    matching no journal entry are orphans (their task was already consumed)
+    and are deleted."""
 
     def __init__(self, journal_dir: str):
         import os
@@ -132,11 +135,21 @@ class ReassignmentJournalDriver(ClusterDriver):
         os.makedirs(self._completed_dir, exist_ok=True)
         self._journal = os.path.join(journal_dir, "reassign_partitions.json")
         self._lock = threading.Lock()
-        for stale in os.listdir(self._completed_dir):
-            try:
-                os.unlink(os.path.join(self._completed_dir, stale))
-            except OSError:
-                pass
+        acked = set()
+        for name in os.listdir(self._completed_dir):
+            if name.endswith(".json") and name[:-5].isdigit():
+                acked.add(int(name[:-5]))
+        entries = self._read_journal()
+        remaining = [e for e in entries if e.get("executionId") not in acked]
+        if len(remaining) != len(entries):
+            self._write_journal(remaining)
+        live_ids = {e.get("executionId") for e in remaining}
+        for eid in acked:
+            if eid not in live_ids:
+                try:
+                    os.unlink(os.path.join(self._completed_dir, f"{eid}.json"))
+                except OSError:
+                    pass
 
     def _read_journal(self) -> List[Dict]:
         import json
@@ -195,8 +208,8 @@ class ReassignmentJournalDriver(ClusterDriver):
                 if e.get("executionId") != task.execution_id
             ]
             self._write_journal(remaining)
-            # consume the ack so a later execution reusing this id (fresh
-            # process, ids restart at 0) can't be spuriously marked done
+            # consume the ack: the journal entry is gone, so the ack has
+            # served its purpose and would otherwise accumulate forever
             try:
                 os.unlink(ack)
             except OSError:

@@ -94,9 +94,14 @@ class Executor:
 
     def user_triggered_stop_execution(self) -> None:
         """Graceful stop (Executor.userTriggeredStopExecution :433)."""
+        from cruise_control_tpu.common.oplog import op_log
+
         with self._lock:
-            if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
+            stopping = self._state != ExecutorState.NO_TASK_IN_PROGRESS
+            if stopping:
                 self._state = ExecutorState.STOPPING_EXECUTION
+        if stopping:
+            op_log("User requested execution stop")
         self._stop_requested.set()
 
     def set_concurrency(self, per_broker: int = None, leadership: int = None) -> None:
@@ -212,8 +217,14 @@ class Executor:
         """Pipelined execution: broker slots refill as individual tasks
         finish, so one slow movement never stalls unrelated brokers
         (the reference refills per poll round the same way)."""
+        from cruise_control_tpu.common.oplog import op_log
+
         with self._lock:
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
+        op_log(
+            "Execution phase: inter-broker replica movement (%d task(s))",
+            len(self._planner.remaining_inter_broker_replica_movements),
+        )
         in_flight: List[ExecutionTask] = []
         polls = 0
         while True:
@@ -247,8 +258,14 @@ class Executor:
                 time.sleep(self._config.execution_progress_check_interval_s)
 
     def _run_leadership_movements(self) -> None:
+        from cruise_control_tpu.common.oplog import op_log
+
         with self._lock:
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
+        op_log(
+            "Execution phase: leadership movement (%d task(s))",
+            len(self._planner.remaining_leadership_movements),
+        )
         while not self._stop_requested.is_set():
             batch = self._planner.get_leadership_movement_tasks(self._manager.leadership_cap)
             if not batch:

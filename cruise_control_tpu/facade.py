@@ -363,6 +363,9 @@ class CruiseControl:
 
         monitor_state = {
             "state": self._monitor.state,
+            # active exclusive mode (BOOTSTRAPPING/TRAINING) + progress, the
+            # reference's LoadMonitorTaskRunner state reporting
+            "activeTask": self._monitor.active_task,
             "generation": self._monitor.generation,
             "sensors": dict(self._monitor.sensors),
         }

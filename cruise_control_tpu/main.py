@@ -140,6 +140,9 @@ def main(argv=None) -> int:
         handler.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
         OPERATION_LOG.addHandler(handler)
         OPERATION_LOG.setLevel(logging.INFO)
+        # audit lines go to the file only — with root logging configured,
+        # propagation would duplicate every line to the root handlers
+        OPERATION_LOG.propagate = False
     start_background(parts)
     print(f"cruise-control-tpu serving on http://{args.host}:{args.port}/kafkacruisecontrol/state")
     run_server(app, host=args.host, port=args.port, access_log_path=args.access_log)

@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -70,6 +71,13 @@ class LoadMonitorState:
     LOADING = "LOADING"
 
 
+class IllegalMonitorStateError(RuntimeError):
+    """An exclusive mode (bootstrap/training) was requested while another is
+    in progress — the reference REJECTS the request rather than queueing it
+    (LoadMonitorTaskRunner.bootstrap :127-177 throws IllegalStateException
+    when the state machine is not in RUNNING)."""
+
+
 class LoadMonitor:
     def __init__(
         self,
@@ -83,6 +91,9 @@ class LoadMonitor:
         self._metadata = metadata_client
         self._sampler = sampler
         self._store = sample_store or NoopSampleStore()
+        # bound the store to the aggregation horizon: older samples can never
+        # contribute to a window (KafkaSampleStore topic-retention analog)
+        self._store.configure_retention(config.window_ms * config.num_windows)
         self._capacity = capacity_resolver or StaticCapacityResolver()
         self._config = config
         self._clock = clock
@@ -91,8 +102,14 @@ class LoadMonitor:
         self._pause_reason: Optional[str] = None
         self._model_semaphore = threading.Semaphore(1)
         self._lock = threading.RLock()
-        #: serializes exclusive modes (one bootstrap/training at a time)
+        #: guards exclusive modes (one bootstrap/training at a time); entry
+        #: is non-blocking — a concurrent request is REJECTED with
+        #: IllegalMonitorStateError, matching the reference's behavior
         self._task_lock = threading.Lock()
+        #: /state reporting of the active exclusive mode + progress
+        #: (the reference surfaces bootstrap progress % via
+        #: LoadMonitorTaskRunner's state)
+        self._active_task: Optional[Dict] = None
         self._last_sample_ms = 0
         # sensor counters (cluster-model-creation-timer analog)
         self.sensors: Dict[str, float] = {"model_creations": 0, "model_creation_time_s": 0.0}
@@ -187,22 +204,72 @@ class LoadMonitor:
                 else LoadMonitorState.RUNNING
             )
 
-    def bootstrap(self, samples: Samples) -> int:
-        """Backfill historic samples (LoadMonitorTaskRunner.bootstrap :127).
+    @contextmanager
+    def _exclusive_mode(self, mode: str, description: str = ""):
+        """Enter an exclusive mode (BOOTSTRAPPING/TRAINING) or REJECT.
 
-        `_task_lock` serializes the exclusive modes: the reference refuses to
-        start a bootstrap/training while another is in progress (:127); this
-        is the single authoritative guard for every entry point (REST and
-        task runner both land here)."""
-        with self._task_lock:
+        The reference refuses to start a bootstrap/training while another
+        exclusive task is in progress (LoadMonitorTaskRunner.bootstrap
+        :127-177); this non-blocking guard is the single authoritative gate
+        for every entry point (REST and task runner both land here)."""
+        if not self._task_lock.acquire(blocking=False):
+            active = (self._active_task or {}).get("mode", "unknown")
+            raise IllegalMonitorStateError(
+                f"cannot start {mode}: {active} is in progress"
+            )
+        try:
             with self._lock:
-                self._state = LoadMonitorState.BOOTSTRAPPING
-            try:
-                topo = self._metadata.refresh_metadata()
-                self._ensure_universe(topo)
-                return self._add_samples(samples, persist=False)
-            finally:
-                self._restore_state()
+                self._state = mode
+                self._active_task = {
+                    "mode": mode, "progress": 0.0, "description": description,
+                }
+            yield
+        finally:
+            with self._lock:
+                self._active_task = None
+            self._restore_state()
+            self._task_lock.release()
+
+    def _set_task_progress(self, fraction: float) -> None:
+        with self._lock:
+            if self._active_task is not None:
+                self._active_task["progress"] = round(min(1.0, max(0.0, fraction)), 4)
+
+    @property
+    def active_task(self) -> Optional[Dict]:
+        """{'mode', 'progress', 'description'} of the running exclusive task
+        (None when idle) — surfaced through /state."""
+        with self._lock:
+            return dict(self._active_task) if self._active_task else None
+
+    def bootstrap(self, samples: Samples) -> int:
+        """Backfill historic samples (LoadMonitorTaskRunner.bootstrap :127)."""
+        with self._exclusive_mode(
+            LoadMonitorState.BOOTSTRAPPING,
+            f"{len(samples.partition_samples)}+{len(samples.broker_samples)} samples",
+        ):
+            topo = self._metadata.refresh_metadata()
+            self._ensure_universe(topo)
+            # ingest in slices so /state reports bootstrap progress
+            part = list(samples.partition_samples)
+            brok = list(samples.broker_samples)
+            total = max(1, len(part) + len(brok))
+            step = max(1, total // 10)
+            added = 0
+            done = 0
+            for lo in range(0, len(part), step):
+                added += self._add_samples(
+                    Samples(part[lo:lo + step], []), persist=False
+                )
+                done += len(part[lo:lo + step])
+                self._set_task_progress(done / total)
+            for lo in range(0, len(brok), step):
+                added += self._add_samples(
+                    Samples([], brok[lo:lo + step]), persist=False
+                )
+                done += len(brok[lo:lo + step])
+                self._set_task_progress(done / total)
+            return added
 
     def bootstrap_range(self, start_ms: int, end_ms: Optional[int] = None) -> int:
         """Time-range bootstrap (BootstrapTask :21, the RANGE/SINCE modes of
@@ -238,40 +305,39 @@ class LoadMonitor:
         TrainingFetcher): feed broker samples from the range into the
         linear-regression CPU model (ModelParameters analog). Returns the fit
         summary; coefficients stay on `self.lr_params` for the estimator."""
-        with self._task_lock:
-            with self._lock:
-                self._state = LoadMonitorState.TRAINING
-            try:
-                _, brok = self._store.load_samples()
-                hi = end_ms if end_ms is not None else int(self._clock() * 1000)
-                n = sum(
-                    self._lr_observe(s.metrics)
-                    for s in brok
-                    if start_ms <= s.time_ms < hi
-                )
-                if n == 0:
-                    # no durable history in range (e.g. Noop store): observe
-                    # the in-memory broker windows instead — the recent
-                    # history the TrainingFetcher would re-sample.
-                    try:
-                        vals = self._broker_agg.aggregate().values  # [B, W, M]
-                    except ValueError:
-                        vals = None
-                    if vals is not None:
-                        n = sum(
-                            self._lr_observe(vals[b, w])
-                            for b in range(vals.shape[0])
-                            for w in range(vals.shape[1])
-                        )
-                coef = self.lr_params.train()
-                return {
-                    "observations_added": int(n),
-                    "total_observations": self.lr_params.num_observations,
-                    "trained": coef is not None,
-                    "coefficients": None if coef is None else [float(c) for c in coef],
-                }
-            finally:
-                self._restore_state()
+        with self._exclusive_mode(
+            LoadMonitorState.TRAINING, f"range [{start_ms}, {end_ms})"
+        ):
+            _, brok = self._store.load_samples()
+            hi = end_ms if end_ms is not None else int(self._clock() * 1000)
+            in_range = [s for s in brok if start_ms <= s.time_ms < hi]
+            n = 0
+            for i, s in enumerate(in_range):
+                n += self._lr_observe(s.metrics)
+                if i % 64 == 0:
+                    self._set_task_progress(i / max(1, len(in_range)))
+            if n == 0:
+                # no durable history in range (e.g. Noop store): observe
+                # the in-memory broker windows instead — the recent
+                # history the TrainingFetcher would re-sample.
+                try:
+                    vals = self._broker_agg.aggregate().values  # [B, W, M]
+                except ValueError:
+                    vals = None
+                if vals is not None:
+                    n = sum(
+                        self._lr_observe(vals[b, w])
+                        for b in range(vals.shape[0])
+                        for w in range(vals.shape[1])
+                    )
+            self._set_task_progress(1.0)
+            coef = self.lr_params.train()
+            return {
+                "observations_added": int(n),
+                "total_observations": self.lr_params.num_observations,
+                "trained": coef is not None,
+                "coefficients": None if coef is None else [float(c) for c in coef],
+            }
 
     def _ensure_universe(self, topo) -> None:
         if topo.num_partitions > self._partition_agg.num_entities:

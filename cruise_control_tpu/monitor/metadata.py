@@ -114,23 +114,48 @@ class BrokerCapacityConfigFileResolver(BrokerCapacityConfigResolver):
     """Reads the reference's capacity.json format
     (cc/config/BrokerCapacityConfigFileResolver.java:69, config/capacity.json):
     a list of {brokerId, capacity: {DISK, CPU, NW_IN, NW_OUT}} entries with
-    brokerId -1 as the default."""
+    brokerId -1 as the default.
+
+    Both disk variants are supported: the flat form (`"DISK": "100000"`) and
+    the JBOD form (`"DISK": {"/logdir1": "250000", "/logdir2": "250000"}` —
+    capacity.JBOD.json), where the broker's DISK capacity is the sum of its
+    log dirs; the per-logdir map is kept on `logdirs_for_broker` for
+    disk-level reporting."""
 
     def __init__(self, path: str):
         with open(path) as f:
             doc = json.load(f)
         self._by_broker: Dict[int, np.ndarray] = {}
+        self._logdirs: Dict[int, Dict[str, float]] = {}
         for entry in doc["brokerCapacities"]:
+            broker_id = int(entry["brokerId"])
             cap = np.zeros(NUM_RESOURCES, dtype=np.float32)
             for name, value in entry["capacity"].items():
-                cap[Resource[name]] = float(value)
-            self._by_broker[int(entry["brokerId"])] = cap
+                if isinstance(value, dict):  # JBOD per-logdir disks
+                    if Resource[name] != Resource.DISK:
+                        raise ValueError(
+                            f"per-logdir capacities only apply to DISK, got {name}"
+                        )
+                    dirs = {d: float(v) for d, v in value.items()}
+                    self._logdirs[broker_id] = dirs
+                    cap[Resource.DISK] = sum(dirs.values())
+                else:
+                    cap[Resource[name]] = float(value)
+            self._by_broker[broker_id] = cap
         if DEFAULT_CAPACITY_BROKER_ID not in self._by_broker:
             raise ValueError("capacity config must define the default (brokerId -1)")
 
     def capacity_for_broker(self, broker_id: int) -> np.ndarray:
         cap = self._by_broker.get(int(broker_id))
         return cap.copy() if cap is not None else self._by_broker[DEFAULT_CAPACITY_BROKER_ID].copy()
+
+    def logdirs_for_broker(self, broker_id: int) -> Dict[str, float]:
+        """Per-logdir DISK capacities (JBOD variant); {} for flat entries.
+        Brokers without an explicit entry inherit the default's dirs."""
+        bid = int(broker_id)
+        if bid in self._by_broker:
+            return dict(self._logdirs.get(bid, {}))
+        return dict(self._logdirs.get(DEFAULT_CAPACITY_BROKER_ID, {}))
 
 
 class StaticCapacityResolver(BrokerCapacityConfigResolver):

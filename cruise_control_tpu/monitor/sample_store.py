@@ -34,6 +34,13 @@ class SampleStore:
         """Replay everything retained (KafkaSampleStore.loadSamples :332)."""
         raise NotImplementedError
 
+    def configure_retention(self, retention_ms: int) -> None:
+        """Hint the aggregation horizon (window_ms * num_windows); stores
+        that persist history may drop anything older. The LoadMonitor calls
+        this at construction — the analog of KafkaSampleStore configuring
+        its sample topics' retention to the horizon
+        (cc/monitor/sampling/KafkaSampleStore.java:79)."""
+
     def close(self) -> None:
         pass
 
@@ -47,27 +54,111 @@ class NoopSampleStore(SampleStore):
 
 
 class FileSampleStore(SampleStore):
-    """Length-prefixed binary records in two append-only files."""
+    """Length-prefixed binary records in time-segmented append files with
+    retention.
 
-    def __init__(self, directory: str):
+    KafkaSampleStore leans on topic retention to bound both storage and the
+    startup replay (cc/monitor/sampling/KafkaSampleStore.java:79 configures
+    the sample topics' retention to the aggregation horizon; loadSamples :332
+    then replays whatever the broker kept). The file analog: records land in
+    segment files named `<kind>-<segment_start_ms>.bin` (segment id = sample
+    time // segment_ms), and segments that end before
+    `newest sample time - retention_ms` are deleted on write and skipped —
+    then deleted — on load. Replay cost is therefore bounded by
+    retention_ms/segment_ms segments regardless of process uptime.
+
+    `retention_ms=None` defers to `configure_retention`, which the
+    LoadMonitor calls with its window_ms * num_windows horizon — samples
+    older than the aggregation horizon can never contribute to a window, so
+    dropping them loses nothing (same argument the reference makes for topic
+    retention). An explicit constructor value wins over the monitor's hint.
+    Legacy unsegmented `<kind>-samples.bin` files from older processes are
+    still read (and counted as one always-retained segment)."""
+
+    SEGMENT_DEFAULT_MS = 3_600_000  # 1h segments unless retention is tighter
+
+    def __init__(self, directory: str, retention_ms: int | None = None,
+                 segment_ms: int | None = None):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
-        self._paths = {
+        self._retention = retention_ms
+        self._retention_pinned = retention_ms is not None
+        self._segment_ms_arg = segment_ms
+        self._segment_ms = self._derive_segment_ms()
+        self._max_time_ms = 0
+        self._legacy = {
             "partition": os.path.join(directory, "partition-samples.bin"),
             "broker": os.path.join(directory, "broker-samples.bin"),
         }
 
-    def _append(self, path: str, samples) -> None:
-        with open(path, "ab") as f:
-            for s in samples:
-                payload = serialize_sample(s)
-                f.write(len(payload).to_bytes(4, "big") + payload)
+    def _derive_segment_ms(self) -> int:
+        if self._segment_ms_arg is not None:
+            return self._segment_ms_arg
+        segment_ms = self.SEGMENT_DEFAULT_MS
+        if self._retention is not None:
+            # >= 8 segments per horizon so expiry is reasonably granular
+            segment_ms = min(segment_ms, max(1, self._retention // 8))
+        return segment_ms
+
+    def configure_retention(self, retention_ms: int) -> None:
+        """Adopt the monitor's aggregation horizon unless the constructor
+        pinned an explicit retention."""
+        with self._lock:
+            if self._retention_pinned:
+                return
+            self._retention = int(retention_ms)
+            self._segment_ms = self._derive_segment_ms()
+
+    def _segment_path(self, kind: str, time_ms: int) -> str:
+        start = (time_ms // self._segment_ms) * self._segment_ms
+        return os.path.join(self._dir, f"{kind}-{start}.bin")
+
+    def _segments(self, kind: str) -> List[Tuple[int, str]]:
+        """[(segment_start_ms, path)] for this kind, oldest first."""
+        out = []
+        prefix = f"{kind}-"
+        for name in os.listdir(self._dir):
+            if name.startswith(prefix) and name.endswith(".bin"):
+                stem = name[len(prefix):-4]
+                if stem.isdigit():
+                    out.append((int(stem), os.path.join(self._dir, name)))
+        return sorted(out)
+
+    def _append(self, kind: str, samples) -> None:
+        by_path: dict = {}
+        for s in samples:
+            payload = serialize_sample(s)
+            by_path.setdefault(self._segment_path(kind, s.time_ms), []).append(payload)
+            if s.time_ms > self._max_time_ms:
+                self._max_time_ms = s.time_ms
+        for path, payloads in by_path.items():
+            with open(path, "ab") as f:
+                for payload in payloads:
+                    f.write(len(payload).to_bytes(4, "big") + payload)
+
+    def _cutoff_ms(self) -> int | None:
+        if self._retention is None:
+            return None
+        return self._max_time_ms - self._retention
+
+    def _expire(self, kind: str) -> None:
+        cutoff = self._cutoff_ms()
+        if cutoff is None:
+            return
+        for start, path in self._segments(kind):
+            if start + self._segment_ms <= cutoff:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
     def store_samples(self, partition_samples, broker_samples) -> None:
         with self._lock:
-            self._append(self._paths["partition"], partition_samples)
-            self._append(self._paths["broker"], broker_samples)
+            self._append("partition", partition_samples)
+            self._append("broker", broker_samples)
+            self._expire("partition")
+            self._expire("broker")
 
     def _read(self, path: str) -> List:
         out = []
@@ -89,6 +180,28 @@ class FileSampleStore(SampleStore):
             pass
         return out
 
+    def _load_kind(self, kind: str) -> List:
+        out = self._read(self._legacy[kind])
+        segments = self._segments(kind)
+        if out or segments:
+            newest = max(
+                [s.time_ms for s in out]
+                + [start + self._segment_ms - 1 for start, _ in segments]
+                or [0]
+            )
+            if newest > self._max_time_ms:
+                self._max_time_ms = newest
+        cutoff = self._cutoff_ms()
+        for start, path in segments:
+            if cutoff is not None and start + self._segment_ms <= cutoff:
+                try:
+                    os.unlink(path)  # truncate on load: bounded restart replay
+                except OSError:
+                    pass
+                continue
+            out.extend(self._read(path))
+        return out
+
     def load_samples(self):
         with self._lock:
-            return self._read(self._paths["partition"]), self._read(self._paths["broker"])
+            return self._load_kind("partition"), self._load_kind("broker")

@@ -114,3 +114,39 @@ class JsonlFileTransport(MetricsTransport):
             except FileNotFoundError:
                 pass
             return out
+
+
+class TcpMetricsTransport(MetricsTransport):
+    """Metrics over the cluster-agent wire protocol (executor/tcp_driver.py):
+    the socket analog of the `__CruiseControlMetrics` topic for deployments
+    where brokers reach the monitor through an agent rather than Kafka.
+
+    Protocol ops (hex-encoded binary records, the serde is the wire format):
+      {"op": "metrics_publish", "records": [hex, ...]} -> {"ok": true}
+      {"op": "metrics_poll", "max": int}
+          -> {"ok": true, "records": [hex, ...]}   (at-most-once consume)
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        from cruise_control_tpu.executor.tcp_driver import _LineClient
+
+        self._client = _LineClient(host, port, timeout_s)
+
+    def publish(self, metrics: List[CruiseControlMetric]) -> None:
+        # NOT retried on a mid-exchange drop: a re-send could double-count
+        # the records agent-side; the reporter's next interval re-samples
+        self._client.request({
+            "op": "metrics_publish",
+            "records": [serialize_metric(m).hex() for m in metrics],
+        }, idempotent=False)
+
+    def poll(self, max_records: int = 10000) -> List[CruiseControlMetric]:
+        # NOT retried: a lost response already consumed its batch agent-side
+        # (at-most-once, same stance as the in-memory transport)
+        resp = self._client.request(
+            {"op": "metrics_poll", "max": max_records}, idempotent=False
+        )
+        return [deserialize_metric(bytes.fromhex(r)) for r in resp.get("records", ())]
+
+    def close(self) -> None:
+        self._client.close()

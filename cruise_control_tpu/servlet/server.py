@@ -554,16 +554,18 @@ def run_server(
     log)."""
     import logging
 
-    access_logger = None
+    # only override aiohttp's access logging when a path was requested;
+    # passing access_log=None would disable the default logger entirely
+    log_kwargs = {}
     if access_log_path:
         access_logger = logging.getLogger("cruise_control_tpu.access")
         access_logger.setLevel(logging.INFO)
         access_logger.propagate = False
         access_logger.addHandler(logging.FileHandler(access_log_path))
+        log_kwargs = {"access_log": access_logger, "access_log_format": NCSA_LOG_FORMAT}
     web.run_app(
         app.build_app(),
         host=host,
         port=port,
-        access_log=access_logger,
-        access_log_format=NCSA_LOG_FORMAT,
+        **log_kwargs,
     )

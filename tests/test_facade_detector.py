@@ -213,6 +213,37 @@ def test_self_healing_end_to_end(stack):
     assert detector.state()["fixesTriggered"]["BROKER_FAILURE"] == 1
 
 
+def test_operation_log_covers_rebalance_and_self_healing(stack, caplog):
+    """One rebalance + one self-healing fix leave a reconstructable audit
+    trail on the operationLogger: execution start, phase transitions, finish,
+    anomaly decision, and fix outcome (the reference's OPERATION_LOG usage in
+    cc/executor/Executor.java and cc/detector/AnomalyDetector.java)."""
+    import logging
+
+    sim, monitor, executor, facade, transport, clock = stack
+    with caplog.at_level(logging.INFO, logger="operationLogger"):
+        facade.rebalance(dryrun=False)
+        detector = AnomalyDetector(
+            facade,
+            notifier=SelfHealingNotifier(
+                broker_failure_alert_threshold_s=0.0, self_healing_threshold_s=0.0
+            ),
+            clock=lambda: clock["now"],
+        )
+        sim.kill_broker(0)
+        clock["now"] = 60.0
+        detector.detect_once()
+        assert detector.handle_once() == "FIX"
+    lines = [r.getMessage() for r in caplog.records if r.name == "operationLogger"]
+    text = "\n".join(lines)
+    assert "Execution started" in text
+    assert "Execution phase: inter-broker replica movement" in text
+    assert "Execution phase: leadership movement" in text
+    assert "Execution finished" in text
+    assert "notifier decided FIX" in text
+    assert "Self-healing fix completed" in text
+
+
 def test_goal_violation_detector_finds_and_fixes(stack):
     sim, monitor, executor, facade, transport, clock = stack
     det = GoalViolationDetector(facade, detection_goals=["ReplicaDistributionGoal"])

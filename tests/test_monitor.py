@@ -367,6 +367,123 @@ def test_monitor_with_fetcher_manager(ground_truth):
     sanity_check(model)
 
 
+def test_capacity_file_resolver_flat_and_jbod(tmp_path):
+    """Reads both reference capacity formats: flat (config/capacity.json) and
+    JBOD per-logdir disks (capacity.JBOD.json,
+    cc/config/BrokerCapacityConfigFileResolver.java:69) — JBOD DISK is the
+    sum of the broker's log dirs."""
+    import json
+
+    from cruise_control_tpu.common.resources import Resource
+    from cruise_control_tpu.monitor.metadata import BrokerCapacityConfigFileResolver
+
+    doc = {
+        "brokerCapacities": [
+            {
+                "brokerId": "-1",
+                "capacity": {
+                    "DISK": {"/tmp/kafka-logs-1": "50000", "/tmp/kafka-logs-2": "50000"},
+                    "CPU": "100",
+                    "NW_IN": "10000",
+                    "NW_OUT": "10000",
+                },
+            },
+            {
+                "brokerId": "0",
+                "capacity": {
+                    "DISK": {
+                        "/tmp/kafka-logs-1": "250000",
+                        "/tmp/kafka-logs-2": "250000",
+                    },
+                    "CPU": "100",
+                    "NW_IN": "50000",
+                    "NW_OUT": "50000",
+                },
+            },
+            {
+                "brokerId": "1",
+                "capacity": {
+                    "DISK": "750000",
+                    "CPU": "150",
+                    "NW_IN": "50000",
+                    "NW_OUT": "50000",
+                },
+            },
+        ]
+    }
+    path = tmp_path / "capacity.JBOD.json"
+    path.write_text(json.dumps(doc))
+    resolver = BrokerCapacityConfigFileResolver(str(path))
+    # JBOD: summed log dirs
+    assert resolver.capacity_for_broker(0)[Resource.DISK] == pytest.approx(500000)
+    assert resolver.logdirs_for_broker(0) == {
+        "/tmp/kafka-logs-1": 250000.0,
+        "/tmp/kafka-logs-2": 250000.0,
+    }
+    # flat entry
+    assert resolver.capacity_for_broker(1)[Resource.DISK] == pytest.approx(750000)
+    assert resolver.capacity_for_broker(1)[Resource.CPU] == pytest.approx(150)
+    assert resolver.logdirs_for_broker(1) == {}  # explicit flat entry: no dirs
+    # unknown broker -> default (JBOD default sums too)
+    assert resolver.capacity_for_broker(7)[Resource.DISK] == pytest.approx(100000)
+    assert resolver.logdirs_for_broker(7) == {
+        "/tmp/kafka-logs-1": 50000.0,
+        "/tmp/kafka-logs-2": 50000.0,
+    }
+
+
+def test_sample_store_retention_bounds_files_and_replay(tmp_path):
+    """Writing windows past retention keeps file count/size bounded and load
+    replays only the retained horizon (KafkaSampleStore topic-retention
+    analog, cc/monitor/sampling/KafkaSampleStore.java:79)."""
+    import os
+
+    from cruise_control_tpu.monitor.samples import BrokerMetricSample, PartitionMetricSample
+
+    retention = 10_000
+    segment = 1_000
+    store = FileSampleStore(str(tmp_path), retention_ms=retention, segment_ms=segment)
+
+    def sizes():
+        files = [f for f in os.listdir(tmp_path) if f.endswith(".bin")]
+        return len(files), sum(os.path.getsize(tmp_path / f) for f in files)
+
+    from cruise_control_tpu.monitor.metricdef import (
+        NUM_BROKER_METRICS,
+        NUM_COMMON_METRICS,
+    )
+
+    metrics = np.ones(NUM_COMMON_METRICS, dtype=np.float32)
+    bmetrics = np.ones(NUM_BROKER_METRICS, dtype=np.float32)
+    counts, bytes_seen = [], []
+    for t in range(0, 50_000, 500):  # 5x the retention horizon
+        store.store_samples(
+            [PartitionMetricSample(1, t, metrics)],
+            [BrokerMetricSample(0, t, bmetrics)],
+        )
+        n, b = sizes()
+        counts.append(n)
+        bytes_seen.append(b)
+    # bounded: file count and total size stop growing once past retention
+    max_segments_per_kind = retention // segment + 2
+    assert max(counts) <= 2 * max_segments_per_kind
+    assert max(bytes_seen[len(bytes_seen) // 2:]) <= max(bytes_seen[: len(bytes_seen) // 2]) * 1.5
+
+    part, brok = store.load_samples()
+    assert part and brok
+    newest = max(s.time_ms for s in part)
+    oldest = min(s.time_ms for s in part)
+    assert newest == 49_500
+    # replay is truncated to the retention horizon (segment-granular)
+    assert oldest >= newest - retention - segment
+
+    # a fresh store over the same directory truncates on load too
+    store2 = FileSampleStore(str(tmp_path), retention_ms=retention, segment_ms=segment)
+    part2, _ = store2.load_samples()
+    assert min(s.time_ms for s in part2) >= newest - retention - segment
+    assert len(part2) == len(part)
+
+
 # -- bootstrap / training tasks (LoadMonitorTaskRunner state machine) ----------
 
 
@@ -400,6 +517,69 @@ def test_train_range_fits_lr_from_store(tmp_path, ground_truth):
     # trained flag requires enough distinct observations; count is what the
     # state machine contract guarantees here
     assert result["total_observations"] == monitor.lr_params.num_observations
+
+
+def test_exclusive_mode_rejection_and_progress(tmp_path, ground_truth):
+    """Illegal transitions are REJECTED, not queued: bootstrap-while-training
+    (and vice versa) raises IllegalMonitorStateError, mirroring
+    LoadMonitorTaskRunner's exclusive-mode guard (:127-177); /state reports
+    the active mode + progress while one runs."""
+    import threading
+
+    from cruise_control_tpu.monitor.load_monitor import IllegalMonitorStateError
+    from cruise_control_tpu.monitor.sampler import Samples
+
+    sim = SimulatedCluster(ground_truth)
+    transport = InMemoryTransport()
+    store = FileSampleStore(str(tmp_path))
+    monitor, clock = make_monitor(sim, transport, store=store)
+    pump(sim, transport, monitor, clock, rounds=2)
+
+    # hold the exclusive lock open from a slow bootstrap on another thread
+    entered = threading.Event()
+    release = threading.Event()
+
+    class SlowSamples:
+        """Partition-sample list whose iteration blocks until released."""
+
+        def __init__(self, inner):
+            self._inner = list(inner)
+
+        def __len__(self):
+            return len(self._inner)
+
+        def __iter__(self):
+            entered.set()
+            release.wait(timeout=10)
+            return iter(self._inner)
+
+    part, brok = store.load_samples()
+    slow = Samples(SlowSamples(part), brok)
+    result = {}
+
+    def run():
+        result["n"] = monitor.bootstrap(slow)
+
+    t = threading.Thread(target=run)
+    t.start()
+    assert entered.wait(timeout=10)
+    # while BOOTSTRAPPING: state + activeTask report it, and both exclusive
+    # modes are rejected
+    assert monitor.state == "BOOTSTRAPPING"
+    active = monitor.active_task
+    assert active is not None and active["mode"] == "BOOTSTRAPPING"
+    assert 0.0 <= active["progress"] <= 1.0
+    with pytest.raises(IllegalMonitorStateError):
+        monitor.train_range(0)
+    with pytest.raises(IllegalMonitorStateError):
+        monitor.bootstrap(Samples([], []))
+    release.set()
+    t.join(timeout=10)
+    assert result["n"] > 0
+    assert monitor.state == "RUNNING"
+    assert monitor.active_task is None
+    # after completion the modes are available again
+    assert monitor.train_range(0)["observations_added"] >= 0
 
 
 def test_task_runner_state_and_sensors(tmp_path, ground_truth):

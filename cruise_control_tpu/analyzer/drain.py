@@ -190,7 +190,17 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
                    rnd=jnp.int32(0)):
         del contrib  # pair surplus is computed from the count table directly
         excess = agg.topic_replica_count.astype(jnp.float32) - gs.upper[:, None]
-        excess = jnp.where(static.alive[None, :], excess, -jnp.inf)
+        # dead brokers: every (topic, broker) group with replicas is a
+        # maximal-surplus pair — evacuation precedes balance
+        # (GoalUtils.ensureNoReplicaOnDeadBrokers), and score_batch's
+        # evacuation bonus makes those moves win regardless of topic math
+        excess = jnp.where(
+            static.alive[None, :],
+            excess,
+            jnp.where(
+                agg.topic_replica_count > 0, jnp.float32(1e9), -jnp.inf
+            ),
+        )
         # Pair selection: ONE pair (the broker's worst over-topic) per source
         # broker, then the top-V brokers. Selecting pairs globally lets many
         # of the V pairs share a source broker, and the waves' per-broker
@@ -347,12 +357,17 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
          dst_candidates, and TopicReplicaDistributionGoal uses its own pair
          round, make_pair_drain_round);
       4. exact [V, K, C] scoring (structural + merged prior-goal tables +
-         this goal), plus a [V, K, R-1] leadership family for goals that
-         shift load by moving leadership;
+         this goal), plus — for goals that shift load by moving leadership —
+         a GLOBAL top-J leadership shortlist from the full [P, R-1] promotion
+         grid (the grid is ~R times smaller than one topic-goal destination
+         scan, and per-source candidate lists systematically miss the
+         mid-weight leaders whose transfer is the only legal action near
+         convergence);
       5. `apply_waves` conflict-free waves: per wave each source nominates its
          best remaining cell (destination axis rotated per wave so the source
          set fans out over destinations; the last wave argmaxes over all
-         cells), nominations are re-scored against CURRENT aggregates, and a
+         cells) and every not-yet-applied leadership entry re-bids; all
+         nominations are re-scored against CURRENT aggregates, and a
          broker-disjoint, partition-disjoint subset applies at once
          (context.wave_select contract).
     """
@@ -361,7 +376,7 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
     k = max(1, min(k_rep, p_count))
     c = max(1, min(c_dst, dims.num_brokers))
     use_leadership = goal.uses_leadership and r >= 2
-    n_lead = r - 1 if use_leadership else 0
+    j_lead = max(1, min(v, p_count * (r - 1))) if use_leadership else 0
 
     def drain_round(static: StaticCtx, agg: Aggregates, tables, gs, contrib,
                     rnd=None):
@@ -371,6 +386,16 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
         _, hot = jax.lax.top_k(rank, v)  # i32[V]
         hot = hot.astype(jnp.int32)
         hot_ok = jnp.isfinite(rank[hot]) | static.dead[hot]
+
+        # EVERY replica on a dead broker is a drain candidate regardless of
+        # the goal's own priorities (GoalUtils.ensureNoReplicaOnDeadBrokers:
+        # evacuation precedes balance for every goal): a goal whose
+        # drain_contrib excludes ordinary replicas (-inf for non-violating /
+        # follower slots) would otherwise rank the dead broker first as a
+        # source yet nominate zero candidates from it
+        valid_slot = agg.assignment >= 0
+        on_dead = static.dead[jnp.where(valid_slot, agg.assignment, 0)] & valid_slot
+        contrib = jnp.where(on_dead, jnp.float32(1e9), contrib)
 
         cand_p, cand_s, cand_ok = heavy_picks(
             static, agg, contrib, hot, k, dims.num_brokers
@@ -394,116 +419,116 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
         s_mv = jnp.where(cand_ok[:, :, None], s_mv, -jnp.inf)
 
         if use_leadership:
-            # leadership family: for drained candidates that ARE leaders,
-            # promoting one of the partition's own followers shifts the
-            # leader-borne load without moving data (the "destination" is
-            # wherever each follower already lives)
-            lslot = jnp.arange(1, r, dtype=jnp.int32)[None, None, :]  # [1,1,R-1]
-            lfull = (v, k, n_lead)
-            lp = jnp.broadcast_to(cand_p[:, :, None], lfull)
-            ldst = agg.assignment[lp, jnp.broadcast_to(lslot, lfull)]
-            lact = build_selected(
-                static.part_load, agg.assignment, lp,
-                jnp.int32(KIND_LEADERSHIP),
-                jnp.broadcast_to(lslot, lfull), ldst,
-            )
-            s_ld = score_batch(static, agg, lact, goal, gs, tables)
-            is_leader_cand = (cand_s == 0) & cand_ok
-            s_ld = jnp.where(is_leader_cand[:, :, None], s_ld, -jnp.inf)
-        else:
-            s_ld = jnp.full((v, k, 0), -jnp.inf)
+            # GLOBAL leadership shortlist: promoting a follower shifts the
+            # leader-borne load without moving data, and the full [P, R-1]
+            # promotion grid is cheap relative to the move grid — per-source
+            # candidate lists systematically miss the mid-weight leaders
+            # whose transfer is the only legal action near convergence
+            from cruise_control_tpu.analyzer.actions import make_leadership_batch
 
-        # cells: [V, K*(C + n_lead)] — first K*C move cells, then leadership
-        cells = jnp.concatenate(
-            [s_mv.reshape(v, k * c), s_ld.reshape(v, k * n_lead)], axis=1
-        )
-        n_cells = k * (c + n_lead)
+            lb = make_leadership_batch(static.part_load, agg.assignment)
+            sl = score_batch(static, agg, lb, goal, gs, tables)
+            sl = jnp.broadcast_to(sl, (p_count, r - 1)).reshape(p_count * (r - 1))
+            lead_s0, lead_i = jax.lax.top_k(sl, j_lead)
+            lead_p = (lead_i // (r - 1)).astype(jnp.int32)
+            lead_slot = (lead_i % (r - 1)).astype(jnp.int32) + 1
+            lead_kind = jnp.full((j_lead,), KIND_LEADERSHIP, dtype=jnp.int32)
+
+        # move cells: [V, K*C]
+        cells = s_mv.reshape(v, k * c)
+        n_cells = k * c
         rows0 = jnp.arange(v, dtype=jnp.int32)
         waves = max(1, apply_waves)
 
-        def cell_action(agg_c, ci):
-            """Materialize the nominated cell per row: ci i32[V] cell index."""
-            is_mv = ci < k * c
-            k_i = jnp.where(is_mv, ci // c, (ci - k * c) // max(n_lead, 1))
-            p_i = cand_p[rows0, k_i]
-            s_i = cand_s[rows0, k_i]
-            if use_leadership:
-                l_i = jnp.where(is_mv, 0, (ci - k * c) % max(n_lead, 1))
-                lead_slot = (l_i + 1).astype(jnp.int32)
-                slot = jnp.where(is_mv, s_i, lead_slot)
-                dst_mv = dsts[rows0, k_i, jnp.where(is_mv, ci % c, 0)]
-                dst = jnp.where(is_mv, dst_mv, agg_c.assignment[p_i, slot])
-                kind = jnp.where(is_mv, KIND_MOVE, KIND_LEADERSHIP).astype(jnp.int32)
-            else:
-                slot = s_i
-                dst = dsts[rows0, k_i, ci % c]
-                kind = jnp.full((v,), KIND_MOVE, dtype=jnp.int32)
+        def move_action(agg_c, ci):
+            """Materialize the nominated move cell per row: ci i32[V]."""
+            k_i = ci // c
             return build_selected(
-                static.part_load, agg_c.assignment, p_i, kind, slot, dst
+                static.part_load, agg_c.assignment,
+                cand_p[rows0, k_i],
+                jnp.full((v,), KIND_MOVE, dtype=jnp.int32),
+                cand_s[rows0, k_i],
+                dsts[rows0, k_i, ci % c],
             )
 
         def wave(carry, w):
-            agg_c, applied_any, blocked = carry
+            agg_c, applied_any, blocked, lead_done = carry
             masked = jnp.where(blocked, -jnp.inf, cells)
 
             def rotated(masked):
                 """Per row: argmax over the K candidates of ONE rotated
-                destination column + all leadership cells — the
-                sorted-by-sorted matching that keeps the whole source set
-                moving in parallel (a full argmax would send every source to
-                the same best destination and disjointness would then admit
-                one action per wave)."""
+                destination column — the sorted-by-sorted matching that keeps
+                the whole source set moving in parallel (a full argmax would
+                send every source to the same best destination and
+                disjointness would then admit one action per wave)."""
                 c_i = ((rows0 + w) % c).astype(jnp.int32)
-                col = masked[:, : k * c].reshape(v, k, c)
+                col = masked.reshape(v, k, c)
                 col = jnp.take_along_axis(col, c_i[:, None, None], axis=2)[:, :, 0]
-                both = jnp.concatenate([col, masked[:, k * c :]], axis=1)
-                j = jnp.argmax(both, axis=1)
-                ci = jnp.where(j < k, j * c + c_i, k * c + (j - k))
-                return ci.astype(jnp.int32), jnp.take_along_axis(both, j[:, None], axis=1)[:, 0]
+                j = jnp.argmax(col, axis=1)
+                ci = j * c + c_i
+                return ci.astype(jnp.int32), jnp.take_along_axis(col, j[:, None], axis=1)[:, 0]
 
             def argmax_all(masked):
                 ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
                 return ci, jnp.take_along_axis(masked, ci[:, None], axis=1)[:, 0]
 
             ci, bs = jax.lax.cond(w == waves - 1, argmax_all, rotated, masked)
-            act = cell_action(agg_c, ci)
+            act = move_action(agg_c, ci)
             s_now = score_batch(static, agg_c, act, goal, gs, tables)
-            ok = jnp.isfinite(bs) & jnp.isfinite(s_now)
+            all_act = act
+            all_score = s_now
+            all_ok = jnp.isfinite(bs) & jnp.isfinite(s_now)
+            if use_leadership:
+                # every not-yet-applied leadership entry re-bids each wave
+                # (its "destination" is wherever the follower lives NOW)
+                l_dst = agg_c.assignment[lead_p, lead_slot]
+                lact = build_selected(
+                    static.part_load, agg_c.assignment, lead_p, lead_kind,
+                    lead_slot, l_dst,
+                )
+                ls_now = score_batch(static, agg_c, lact, goal, gs, tables)
+                lok = jnp.isfinite(lead_s0) & jnp.isfinite(ls_now) & ~lead_done
+                all_act = jax.tree.map(
+                    lambda a, b: jnp.concatenate(
+                        [jnp.broadcast_to(a, (v,) + a.shape[1:]),
+                         jnp.broadcast_to(b, (j_lead,) + b.shape[1:])]
+                    ),
+                    act, lact,
+                )
+                all_score = jnp.concatenate([s_now, ls_now])
+                all_ok = jnp.concatenate([all_ok[:v], lok])
             sel = wave_select(
-                s_now, act.src, act.dst, static.broker_host[act.dst], ok,
+                all_score, all_act.src, all_act.dst,
+                static.broker_host[all_act.dst], all_ok,
                 dims.num_brokers, dims.num_hosts,
-                parts=(act.p,), num_partitions=p_count,
+                parts=(all_act.p,), num_partitions=p_count,
             )
-            agg_c = apply_actions_batch(static, agg_c, act, sel)
-            # applied move cells: the replica is gone from its source — block
-            # its whole K-row slice would be wrong; block just the cell, and
-            # block every cell of that (row, k) candidate via rep_gone below.
+            agg_c = apply_actions_batch(static, agg_c, all_act, sel)
+            sel_mv = sel[:v]
             # A nomination that failed re-scoring is a dead cell; conflict
-            # losers stay available for later waves.
-            dead = sel | (jnp.isfinite(bs) & ~jnp.isfinite(s_now))
-            k_i = jnp.where(ci < k * c, ci // c, (ci - k * c) // max(n_lead, 1))
-            gone = sel & (ci < k * c)  # replica left its broker
-            row_base = k_i * c
+            # losers stay available for later waves. An applied move's
+            # candidate replica left its source, so ALL its destination
+            # cells die.
+            dead = sel_mv | (jnp.isfinite(bs) & ~jnp.isfinite(s_now))
+            k_i = ci // c
             blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
-            # blanket-block all C destinations of a moved candidate replica
             cols = jnp.arange(c, dtype=jnp.int32)[None, :]
-            cell_ids = row_base[:, None] + cols  # [V, C]
+            cell_ids = (k_i * c)[:, None] + cols  # [V, C]
             blk = blk.at[rows0[:, None], cell_ids].set(
-                blk[rows0[:, None], cell_ids] | gone[:, None]
+                blk[rows0[:, None], cell_ids] | sel_mv[:, None]
             )
             if use_leadership:
-                # a moved or promoted candidate's leadership cells die too
-                lbase = k * c + k_i * n_lead
-                lcols = jnp.arange(n_lead, dtype=jnp.int32)[None, :]
-                lids = lbase[:, None] + lcols
-                changed = sel
-                blk = blk.at[rows0[:, None], lids].set(
-                    blk[rows0[:, None], lids] | changed[:, None]
+                lead_done = lead_done | sel[v:] | (
+                    jnp.isfinite(lead_s0) & ~jnp.isfinite(ls_now)
                 )
-            return (agg_c, applied_any | jnp.any(sel), blk), None
+            return (agg_c, applied_any | jnp.any(sel), blk, lead_done), None
 
-        init = (agg, jnp.asarray(False), jnp.zeros((v, n_cells), dtype=bool))
-        (agg2, applied_any, _), _ = jax.lax.scan(
+        init = (
+            agg, jnp.asarray(False), jnp.zeros((v, n_cells), dtype=bool),
+            jnp.zeros((max(j_lead, 1),), dtype=bool)[:j_lead]
+            if use_leadership else jnp.zeros((0,), dtype=bool),
+        )
+        (agg2, applied_any, _, _), _ = jax.lax.scan(
             wave, init, jnp.arange(waves, dtype=jnp.int32)
         )
         return agg2, applied_any

@@ -46,6 +46,15 @@ class Goal:
     #: run the replica-swap search when plain moves stall (requires a
     #: `resource` attribute; ResourceDistributionGoal's rebalanceBySwapping*)
     uses_swaps: bool = False
+    #: rotate drain-candidate ranking across rounds: when a goal's top-K
+    #: candidates can be uniformly infeasible (e.g. a hot broker's heaviest
+    #: leaders all exceed every destination's bound while mid-sized ones
+    #: fit), a deterministic top-K starves the goal; a round-seeded
+    #: multiplicative jitter walks the candidate order instead (validation
+    #: is exact, so ordering is free). Goals setting this also get the
+    #: multi-round stall patience (one empty round only proves one rotation
+    #: slice is blocked).
+    rotate_drain_candidates: bool = False
 
     def prepare(self, static: StaticCtx, agg: Aggregates, dims) -> Any:
         """Per-goal threshold state derived from current aggregates."""

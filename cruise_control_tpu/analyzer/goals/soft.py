@@ -214,6 +214,7 @@ class LeaderReplicaDistributionGoal(Goal):
 
     name = "LeaderReplicaDistributionGoal"
     uses_leadership = True
+    rotate_drain_candidates = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
@@ -407,6 +408,7 @@ class LeaderBytesInDistributionGoal(Goal):
 
     name = "LeaderBytesInDistributionGoal"
     uses_leadership = True
+    rotate_drain_candidates = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)

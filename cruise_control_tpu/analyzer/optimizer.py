@@ -396,10 +396,13 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             settings.swaps_per_broker, apply_waves=settings.apply_waves,
         )
 
-    # pair-drain rounds rotate through tie-ranked surplus slices, so one
-    # empty round only proves one SLICE is blocked; several consecutive empty
-    # rounds (covering different rotations) are required to call it converged
-    empties_to_stall = 8 if getattr(goal, "pair_drain", False) else 1
+    # goals with rotated candidate selection (pair-drain slices, jittered
+    # drain ranking) only prove ONE rotation slice blocked per empty round;
+    # several consecutive empty rounds are required to call them converged
+    rotated = getattr(goal, "pair_drain", False) or getattr(
+        goal, "rotate_drain_candidates", False
+    )
+    empties_to_stall = 8 if rotated else 1
 
     def goal_loop(static: StaticCtx, agg: Aggregates, tables, budget=None,
                   rnd_base=None, empties0=None):
@@ -430,6 +433,17 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 # the goal's per-replica drain priority, shared by the drain
                 # round and (on stall) the swap search
                 contrib = goal.drain_contrib(static, gs0, agg_c)
+                if getattr(goal, "rotate_drain_candidates", False):
+                    # round-seeded multiplicative jitter in [0.5, 1): walks
+                    # the candidate ranking so a uniformly-infeasible top-K
+                    # cannot starve the goal (ordering is free — every
+                    # nomination is exactly re-validated before applying)
+                    p_ids = jnp.arange(contrib.shape[0], dtype=jnp.uint32)
+                    h = (p_ids + rnd.astype(jnp.uint32) * jnp.uint32(40503)) * jnp.uint32(
+                        2654435761
+                    )
+                    rot = (h >> 8).astype(jnp.float32) / float(1 << 24)
+                    contrib = contrib * (0.5 + 0.5 * rot)[:, None]
                 agg2, applied = drain_fn(static, agg_c, tables, gs0, contrib, rnd)
             else:
                 agg2, applied = one_round(static, agg_c, tables)

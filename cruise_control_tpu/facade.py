@@ -12,7 +12,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Dict, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -48,6 +48,9 @@ class FacadeConfig:
     default_requirements: ModelCompletenessRequirements = ModelCompletenessRequirements(
         min_required_num_windows=1, min_monitored_partitions_percentage=0.5
     )
+    #: goals used when a request names none — the reference's `default.goals`
+    #: key (operators commonly trim the stack); None = the full priority order
+    default_goal_names: Optional[Tuple[str, ...]] = None
 
 
 class CruiseControl:
@@ -131,6 +134,18 @@ class CruiseControl:
         ]
         return result
 
+    def _effective_goals(self, goal_names: Optional[Sequence[str]]):
+        """Requested goals in priority order; falls back to the configured
+        default.goals list, then to the full stack (None)."""
+        if goal_names:
+            return self.goals_by_priority(goal_names)
+        if self._config.default_goal_names:
+            # the configured default goes through the same validation +
+            # priority ordering as any request (a verbatim list would run in
+            # operator order, changing acceptance-table semantics)
+            return self.goals_by_priority(self._config.default_goal_names)
+        return None
+
     def get_proposals(
         self,
         goal_names: Optional[Sequence[str]] = None,
@@ -174,7 +189,7 @@ class CruiseControl:
             generation = -1
         result = self._optimizer.optimizations(
             model,
-            goal_names=self.goals_by_priority(goal_names) if goal_names else None,
+            goal_names=self._effective_goals(goal_names),
             options=options,
             raise_on_hard_failure=not options.is_triggered_by_goal_violation,
         )
@@ -227,7 +242,7 @@ class CruiseControl:
         model = model._replace(broker_state=state)
         result = self._optimizer.optimizations(
             model,
-            goal_names=self.goals_by_priority(goal_names) if goal_names else None,
+            goal_names=self._effective_goals(goal_names),
             options=resolve_options(options, model, _meta.topic_names),
         )
         result = self._attach_topic_names(result, _meta)
@@ -251,7 +266,7 @@ class CruiseControl:
         state[list(broker_indices)] = BrokerState.NEW
         model = model._replace(broker_state=state)
         result = self._optimizer.optimizations(
-            model, goal_names=self.goals_by_priority(goal_names) if goal_names else None
+            model, goal_names=self._effective_goals(goal_names)
         )
         result = self._attach_topic_names(result, _meta)
         if not dryrun:

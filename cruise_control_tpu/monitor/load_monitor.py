@@ -91,9 +91,12 @@ class LoadMonitor:
         self._metadata = metadata_client
         self._sampler = sampler
         self._store = sample_store or NoopSampleStore()
-        # bound the store to the aggregation horizon: older samples can never
-        # contribute to a window (KafkaSampleStore topic-retention analog)
-        self._store.configure_retention(config.window_ms * config.num_windows)
+        # bound the store to a multiple of the aggregation horizon: samples
+        # past the horizon can't contribute to windows, but train_range /
+        # bootstrap_range replay deeper history for the LR CPU model and
+        # backfills, so keep several horizons (KafkaSampleStore's topic
+        # retention is likewise operator-sized above the window horizon)
+        self._store.configure_retention(8 * config.window_ms * config.num_windows)
         self._capacity = capacity_resolver or StaticCapacityResolver()
         self._config = config
         self._clock = clock

@@ -184,9 +184,12 @@ class FileSampleStore(SampleStore):
         out = self._read(self._legacy[kind])
         segments = self._segments(kind)
         if out or segments:
+            # estimate the newest sample time from segment STARTS — an
+            # underestimate. Using segment ends would inflate the cutoff by
+            # up to one segment and delete still-in-retention history at
+            # restart; an underestimate only ever keeps one extra segment.
             newest = max(
-                [s.time_ms for s in out]
-                + [start + self._segment_ms - 1 for start, _ in segments]
+                [s.time_ms for s in out] + [start for start, _ in segments]
                 or [0]
             )
             if newest > self._max_time_ms:

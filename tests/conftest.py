@@ -36,3 +36,23 @@ def _release_compiled_programs():
     import jax
 
     jax.clear_caches()
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        "--runslow", action="store_true", default=False,
+        help="run the opt-in slow lane (redundant-coverage compile-heavy cases)",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Opt-in slow lane: every XLA compile on this 1-core box costs tens of
+    seconds, so cases that only widen coverage already held by a sibling
+    (e.g. one single-goal program per goal when one per goal FAMILY already
+    compiles the same kernels) are deselected unless --runslow is given."""
+    if config.getoption("--runslow"):
+        return
+    skip = pytest.mark.skip(reason="slow lane: pass --runslow to include")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)

@@ -62,7 +62,14 @@ def stack():
         executor,
         optimizer=GoalOptimizer(settings=FAST),
         config=FacadeConfig(
-            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False),
+            # trimmed default stack: these tests exercise cache/flow/detector
+            # semantics, not the full goal inventory, and each distinct goal
+            # stack is an XLA compile (~tens of seconds on this box)
+            default_goal_names=(
+                "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+                "ReplicaDistributionGoal", "LeaderReplicaDistributionGoal",
+            ),
         ),
     )
     return sim, monitor, executor, facade, transport, clock

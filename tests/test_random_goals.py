@@ -38,7 +38,30 @@ def model():
     return generators.random_cluster(seed=11, prop=prop)
 
 
-@pytest.mark.parametrize("goal_name", [g.name for g in DEFAULT_GOAL_ORDER])
+#: single-goal programs compile one whole stack program EACH (tens of seconds
+#: per goal on one core); the fast lane keeps one goal per kernel family —
+#: rack, capacity w/ host axis, count distribution, usage distribution +
+#: swaps, pair drain, leadership, potential-NW-out — and the remaining goals
+#: (thin parameterizations of the same kernels) ride the --runslow lane
+FAST_SINGLE_GOALS = {
+    "RackAwareGoal",
+    "CpuCapacityGoal",
+    "ReplicaDistributionGoal",
+    "DiskUsageDistributionGoal",
+    "TopicReplicaDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+    "PotentialNwOutGoal",
+}
+
+
+@pytest.mark.parametrize(
+    "goal_name",
+    [
+        g.name if g.name in FAST_SINGLE_GOALS
+        else pytest.param(g.name, marks=pytest.mark.slow)
+        for g in DEFAULT_GOAL_ORDER
+    ],
+)
 def test_single_goal(model, goal_name):
     result = GoalOptimizer(settings=SETTINGS).optimizations(
         model, goal_names=[goal_name], raise_on_hard_failure=False
@@ -71,20 +94,48 @@ def test_empty_goal_list_is_noop(model):
     assert np.array_equal(result.final_assignment, np.asarray(model.assignment))
 
 
-def test_random_subsets_with_dead_broker(model):
+def test_dead_broker_evacuation_with_selective_goals(model):
+    """DEAD_BROKERS invariant for the nastiest goal subset: goals whose drain
+    priorities exclude ordinary replicas (RackAware drains only
+    rack-violating replicas, LeaderBytesIn only leader slots, TopicReplica
+    only over-count pairs). The drain engine must still evacuate every
+    dead-broker replica — the regression this pins down ranked the dead
+    broker first as a source but nominated zero candidates from it."""
+    state = np.asarray(model.broker_state).copy()
+    state[3] = BrokerState.DEAD
+    dead_model = model._replace(broker_state=state)
+    for names in (
+        ["RackAwareGoal", "LeaderBytesInDistributionGoal"],
+        ["TopicReplicaDistributionGoal"],
+    ):
+        result = GoalOptimizer(settings=SETTINGS).optimizations(
+            dead_model, goal_names=names, raise_on_hard_failure=False
+        )
+        assert not (result.final_assignment == 3).any(), names
+        sanity_check(dead_model._replace(assignment=result.final_assignment))
+
+
+@pytest.mark.parametrize(
+    "trial",
+    # every trial's goal subset is a distinct XLA program: one rides the
+    # fast lane, the rest the --runslow lane (the deterministic
+    # selective-goal evacuation test above keeps the DEAD_BROKERS invariant
+    # covered in the fast lane)
+    [0, pytest.param(1, marks=pytest.mark.slow), pytest.param(2, marks=pytest.mark.slow)],
+)
+def test_random_subsets_with_dead_broker(model, trial):
     """RandomSelfHealingTest analog: any goal subset must evacuate dead
     brokers and never regress the requested goals' costs."""
-    rng = np.random.default_rng(7)
+    rng = np.random.default_rng(7 + trial)
     state = np.asarray(model.broker_state).copy()
     state[3] = BrokerState.DEAD
     dead_model = model._replace(broker_state=state)
     all_names = [g.name for g in DEFAULT_GOAL_ORDER]
-    for trial in range(3):
-        k = int(rng.integers(2, len(all_names)))
-        names = list(rng.choice(all_names, size=k, replace=False))
-        result = GoalOptimizer(settings=SETTINGS).optimizations(
-            dead_model, goal_names=names, raise_on_hard_failure=False
-        )
-        assert not (result.final_assignment == 3).any(), (trial, names)
-        fixed = dead_model._replace(assignment=result.final_assignment)
-        sanity_check(fixed)
+    k = int(rng.integers(2, len(all_names)))
+    names = list(rng.choice(all_names, size=k, replace=False))
+    result = GoalOptimizer(settings=SETTINGS).optimizations(
+        dead_model, goal_names=names, raise_on_hard_failure=False
+    )
+    assert not (result.final_assignment == 3).any(), (trial, names)
+    fixed = dead_model._replace(assignment=result.final_assignment)
+    sanity_check(fixed)

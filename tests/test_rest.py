@@ -61,7 +61,15 @@ def server():
     executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
     facade = CruiseControl(
         monitor, executor, optimizer=GoalOptimizer(settings=FAST),
-        config=FacadeConfig(default_requirements=ModelCompletenessRequirements(1, 0.5, False)),
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False),
+            # trimmed default stack: REST tests exercise the wire contract;
+            # each distinct goal stack is an XLA compile
+            default_goal_names=(
+                "RackAwareGoal", "ReplicaCapacityGoal", "DiskCapacityGoal",
+                "ReplicaDistributionGoal", "LeaderReplicaDistributionGoal",
+            ),
+        ),
     )
     acc = AsyncCruiseControl(facade)
     detector = AnomalyDetector(facade, notifier=SelfHealingNotifier(), clock=lambda: clock["now"])

@@ -155,6 +155,122 @@ def rack_diverse_cold(static: StaticCtx, gs, agg: Aggregates, goal, tables,
     return head
 
 
+def select_surplus_pairs(static: StaticCtx, agg: Aggregates, tables, gs,
+                         rnd, v: int, t_count: int, b_count: int):
+    """(pair_t, pair_b, pair_ok), each [V]: one (topic, broker) surplus pair
+    per source broker — the broker's worst over-topic — for the top-V
+    brokers, shared by the topic pair-drain and topic-swap rounds.
+
+    Dead brokers: every (topic, broker) group with replicas is a
+    maximal-surplus pair — evacuation precedes balance
+    (GoalUtils.ensureNoReplicaOnDeadBrokers), and score_batch's evacuation
+    bonus makes those moves win regardless of topic math. Tie-breaks are
+    round-rotated: surplus is almost always exactly 1, so a fixed order
+    would retry the same (possibly band-blocked) pairs every round while
+    thousands behind them go untried. A mobility proxy ranks brokers that
+    can shed an average-sized replica without breaking a contributed lower
+    bound above band-frozen ones (which still surface once the mobile set
+    drains)."""
+    excess = agg.topic_replica_count.astype(jnp.float32) - gs.upper[:, None]
+    excess = jnp.where(
+        static.alive[None, :],
+        excess,
+        jnp.where(agg.topic_replica_count > 0, jnp.float32(1e9), -jnp.inf),
+    )
+    t_ids = jnp.arange(t_count, dtype=jnp.int32)
+    rot_t = (((t_ids + rnd * 7919) * 131) % 104729).astype(jnp.float32) / 104729.0
+    key_tb = jnp.where(
+        jnp.isfinite(excess), excess + 1e-3 * rot_t[:, None], -jnp.inf
+    )
+    best_t = jnp.argmax(key_tb, axis=0).astype(jnp.int32)  # [B]
+    b_ids = jnp.arange(b_count, dtype=jnp.int32)
+    best_val = excess[best_t, b_ids]
+    rot_b = (((b_ids + rnd * 104729) * 257) % 7919).astype(jnp.float32) / 7919.0
+    typ = jnp.sum(agg.broker_load, axis=0) / jnp.maximum(
+        1.0, jnp.sum(agg.replica_count).astype(jnp.float32)
+    )  # f32[4] mean per-replica load
+    lo_margin = agg.broker_load - tables.band_lo
+    mobile = jnp.all(
+        ~tables.band_on[None, :] | (lo_margin >= 0.5 * typ[None, :]), axis=1
+    )
+    mobile = mobile & (
+        agg.replica_count.astype(jnp.float32) - 1.0 >= tables.lo_rep
+    )
+    brk_key = jnp.where(
+        jnp.isfinite(best_val) & (best_val > 0.0),
+        best_val + jnp.where(mobile, 1e3, 0.0) + 1e-3 * rot_b, -jnp.inf,
+    )
+    _, hot_b = jax.lax.top_k(brk_key, v)
+    pair_b = hot_b.astype(jnp.int32)
+    pair_t = best_t[pair_b]
+    vals = excess[pair_t, pair_b]
+    return pair_t, pair_b, jnp.isfinite(vals) & (vals > 0.0)
+
+
+def pair_replica_picks(static: StaticCtx, agg: Aggregates, pair_t, pair_b,
+                       k: int, t_count: int, b_count: int):
+    """(cand_p, cand_s, found) [V, k]: the first k movable replicas of each
+    (topic, broker) pair, via iterated segment-min of flat position over
+    group ids (sort-free)."""
+    p_count, r = agg.assignment.shape
+    n = p_count * r
+    n_groups = t_count * b_count
+    pair_idx = pair_t * b_count + pair_b
+    movable = static.movable_partition[:, None] & (agg.assignment >= 0)
+    group = static.topic_id[:, None] * b_count + jnp.where(
+        movable, agg.assignment, 0
+    )
+    seg = jnp.where(movable, group, n_groups).reshape(n)
+    pos = jnp.arange(n, dtype=jnp.int32)
+    excluded = jnp.zeros((n,), dtype=bool)
+    cols = []
+    for _ in range(k):
+        mth = jax.ops.segment_min(
+            jnp.where(excluded, n, pos), seg, num_segments=n_groups + 1
+        )
+        cols.append(mth[pair_idx])
+        excluded = excluded | (pos == mth[seg])
+    picks = jnp.stack(cols, axis=1)  # [V, k]
+    found = picks < n
+    sel = jnp.minimum(picks, n - 1)
+    return (sel // r).astype(jnp.int32), (sel % r).astype(jnp.int32), found
+
+
+def topic_dst_list(static: StaticCtx, agg: Aggregates, tables, gs,
+                   pair_t, pair_b, rnd, c_dst: int, b_count: int):
+    """i32[V, C]: per-pair destination candidates — brokers that are BOTH
+    under-count for the pair's topic AND have load-band headroom (the prior
+    usage goals' bands are what actually veto most destinations; a
+    topic-only ranking finds under-count brokers whose bands then reject
+    everything). Band headroom is a scalar proxy (mean replica load) and
+    exact validation decides; the jitter is ROUND-rotated so near-tied
+    candidates beyond the first C surface on later rounds instead of being
+    permanently shadowed by the same top C."""
+    cnt_rows = agg.topic_replica_count[pair_t].astype(jnp.float32)  # [V, B]
+    topic_ok = static.replica_dst_ok[None, :] & (
+        cnt_rows + 1.0 <= gs.upper[pair_t][:, None]
+    )
+    typ = jnp.sum(agg.broker_load, axis=0) / jnp.maximum(
+        1.0, jnp.sum(agg.replica_count).astype(jnp.float32)
+    )
+    band_room = jnp.all(
+        ~tables.band_on[None, :]
+        | (agg.broker_load + 0.5 * typ[None, :] <= tables.band_hi),
+        axis=1,
+    )  # bool[B]
+    d_pref = jnp.where(
+        topic_ok,
+        -cnt_rows + jnp.where(band_room, 1e3, 0.0)[None, :],
+        -jnp.inf,
+    )
+    b_all = jnp.arange(b_count, dtype=jnp.int32)
+    jit_d = (
+        (b_all[None, :] + pair_b[:, None] * 151 + rnd * 977) % b_count
+    ).astype(jnp.float32) / b_count
+    _, dst_list = jax.lax.top_k(d_pref + 1e-4 * jit_d, c_dst)  # [V, C]
+    return dst_list.astype(jnp.int32)
+
+
 def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
     """Drain round for TopicReplicaDistributionGoal, whose natural candidate
     unit is the (topic, broker) SURPLUS PAIR — the same granularity the
@@ -172,10 +288,14 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
          previously-optimized goals' load bands at any destination — the
          waves' exact re-scoring keeps extra candidates safe (once the pair
          is no longer over, the remaining candidates stop scoring);
-      3. exact scores against ALL brokers ([V, 2, B]): a feasible destination
-         must be under-count for the pair's topic AND inside every
-         previously-optimized goal's bands — a rare intersection once the
-         usage goals have converged, which pruned destination lists miss;
+      3. exact scores against a round-rotated top-C destination list per pair
+         (topic_dst_list): a feasible destination must be under-count for the
+         pair's topic AND inside every previously-optimized goal's bands — a
+         rare intersection once the usage goals have converged. The proxy
+         ranking puts band-feasible under-count brokers first, the rotation
+         surfaces candidates beyond C across rounds, and the topic-SWAP
+         fallback (make_topic_swap_round) escapes pairs whose every single
+         move is band-frozen;
       4. waves argmax the remaining cells (blocked-cell bookkeeping), apply a
          broker/partition-disjoint subset, repeat.
     """
@@ -189,100 +309,30 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
     def pair_round(static: StaticCtx, agg: Aggregates, tables, gs, contrib,
                    rnd=jnp.int32(0)):
         del contrib  # pair surplus is computed from the count table directly
-        excess = agg.topic_replica_count.astype(jnp.float32) - gs.upper[:, None]
-        # dead brokers: every (topic, broker) group with replicas is a
-        # maximal-surplus pair — evacuation precedes balance
-        # (GoalUtils.ensureNoReplicaOnDeadBrokers), and score_batch's
-        # evacuation bonus makes those moves win regardless of topic math
-        excess = jnp.where(
-            static.alive[None, :],
-            excess,
-            jnp.where(
-                agg.topic_replica_count > 0, jnp.float32(1e9), -jnp.inf
-            ),
+        # one surplus pair per source broker (waves admit one action per
+        # source per wave, so distinct sources maximize round throughput);
+        # dead-broker evacuation, tie-rotation, and the mobility proxy all
+        # live in the shared selector
+        pair_t, pair_b, pair_ok = select_surplus_pairs(
+            static, agg, tables, gs, rnd, v, t_count, b_count
         )
-        # Pair selection: ONE pair (the broker's worst over-topic) per source
-        # broker, then the top-V brokers. Selecting pairs globally lets many
-        # of the V pairs share a source broker, and the waves' per-broker
-        # disjointness then caps the round's throughput well below V; one
-        # pair per broker gives V DISTINCT sources per round (a broker's
-        # remaining over-topics surface in later rounds). Tie-breaks are
-        # round-rotated: surplus is almost always exactly 1, so a fixed
-        # order would retry the same (possibly band-blocked) pairs every
-        # round while thousands behind them go untried; the rotation walks
-        # the whole surplus set. Rotation magnitudes stay far below 1 so
-        # real excess differences still dominate.
-        t_ids = jnp.arange(t_count, dtype=jnp.int32)
-        rot_t = (((t_ids + rnd * 7919) * 131) % 104729).astype(jnp.float32) / 104729.0
-        key_tb = jnp.where(
-            jnp.isfinite(excess), excess + 1e-3 * rot_t[:, None], -jnp.inf
+        cand_p, cand_s, found = pair_replica_picks(
+            static, agg, pair_t, pair_b, k, t_count, b_count
         )
-        best_t = jnp.argmax(key_tb, axis=0).astype(jnp.int32)  # [B]
-        b_ids = jnp.arange(b_count, dtype=jnp.int32)
-        best_val = excess[best_t, b_ids]
-        rot_b = (((b_ids + rnd * 104729) * 257) % 7919).astype(jnp.float32) / 7919.0
-        # mobility proxy: once the usage goals have converged, most brokers
-        # sit close enough to a band LOWER bound that no replica can leave
-        # without breaking it (two-case acceptance, case 1) — selecting such
-        # frozen brokers wastes the round's source slots, and measured
-        # per-round throughput tracks the feasible fraction almost exactly.
-        # A broker is "mobile" if it can shed an average-sized replica and
-        # stay above every contributed lower bound; mobile surplus brokers
-        # outrank frozen ones (which still surface once the mobile set
-        # drains — moving load ONTO a frozen broker's band unfreezes it
-        # later, so they are deprioritized, not excluded).
-        typ = jnp.sum(agg.broker_load, axis=0) / jnp.maximum(
-            1.0, jnp.sum(agg.replica_count).astype(jnp.float32)
-        )  # f32[4] mean per-replica load
-        lo_margin = agg.broker_load - jnp.where(
-            jnp.isfinite(tables.band_lo), tables.band_lo, -jnp.inf
-        )
-        mobile = jnp.all(
-            ~tables.band_on[None, :] | (lo_margin >= 0.5 * typ[None, :]), axis=1
-        )
-        mobile = mobile & (
-            agg.replica_count.astype(jnp.float32) - 1.0 >= tables.lo_rep
-        )
-        brk_key = jnp.where(
-            jnp.isfinite(best_val) & (best_val > 0.0),
-            best_val + jnp.where(mobile, 1e3, 0.0) + 1e-3 * rot_b, -jnp.inf,
-        )
-        _, hot_b = jax.lax.top_k(brk_key, v)
-        pair_b = hot_b.astype(jnp.int32)
-        pair_t = best_t[pair_b]
-        pair_idx = pair_t * b_count + pair_b
-        vals = excess[pair_t, pair_b]
-        pair_ok = jnp.isfinite(vals) & (vals > 0.0)
+        cand_ok = found & pair_ok[:, None]
 
-        # the first k movable replicas of each pair, via iterated segment-min
-        # of flat position over (topic, broker) group ids
-        movable = static.movable_partition[:, None] & (agg.assignment >= 0)
-        group = static.topic_id[:, None] * b_count + jnp.where(
-            movable, agg.assignment, 0
+        c_dst = min(64, b_count)
+        dst_list = topic_dst_list(
+            static, agg, tables, gs, pair_t, pair_b, rnd, c_dst, b_count
         )
-        seg = jnp.where(movable, group, n_groups).reshape(n)
-        pos = jnp.arange(n, dtype=jnp.int32)
-        excluded = jnp.zeros((n,), dtype=bool)
-        cols = []
-        for _ in range(k):
-            mth = jax.ops.segment_min(
-                jnp.where(excluded, n, pos), seg, num_segments=n_groups + 1
-            )
-            cols.append(mth[pair_idx])
-            excluded = excluded | (pos == mth[seg])
-        picks = jnp.stack(cols, axis=1)  # [V, k]
-        cand_ok = (picks < n) & pair_ok[:, None]
-        sel = jnp.minimum(picks, n - 1)
-        cand_p = (sel // r).astype(jnp.int32)
-        cand_s = (sel % r).astype(jnp.int32)
 
-        full = (v, k, b_count)
+        full = (v, k, c_dst)
         acts = build_selected(
             static.part_load, agg.assignment,
             jnp.broadcast_to(cand_p[:, :, None], full),
             jnp.int32(KIND_MOVE),
             jnp.broadcast_to(cand_s[:, :, None], full),
-            jnp.broadcast_to(jnp.arange(b_count, dtype=jnp.int32)[None, None, :], full),
+            jnp.broadcast_to(dst_list[:, None, :], full),
         )
         s = score_batch(static, agg, acts, goal, gs, tables)
         s = jnp.where(cand_ok[:, :, None], s, -jnp.inf)
@@ -293,13 +343,10 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
         # moves per wave. A deterministic per-(row, dst) jitter far below any
         # real score difference spreads the nominations; validation re-scores
         # exactly, so the jitter never changes legality.
+        # the per-pair destination lists are already jittered (jit_d above),
+        # so near-tied rows nominate different brokers
         rows0 = jnp.arange(v, dtype=jnp.int32)
-        dst_ids = jnp.arange(b_count, dtype=jnp.int32)
-        jitter = ((dst_ids[None, :] + rows0[:, None] * 131) % b_count).astype(
-            jnp.float32
-        ) / b_count
-        s = s + 1e-5 * jitter[:, None, :]
-        cells = s.reshape(v, k * b_count)
+        cells = s.reshape(v, k * c_dst)
         waves = max(1, apply_waves)
 
         def wave(carry, w):
@@ -308,10 +355,10 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
             masked = jnp.where(blocked, -jnp.inf, cells)
             ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
             bs = jnp.take_along_axis(masked, ci[:, None], axis=1)[:, 0]
-            k_i = ci // b_count
+            k_i = ci // c_dst
             p_i = cand_p[rows0, k_i]
             s_i = cand_s[rows0, k_i]
-            dst = (ci % b_count).astype(jnp.int32)
+            dst = dst_list[rows0, ci % c_dst]
             act = build_selected(
                 static.part_load, agg_c.assignment, p_i,
                 jnp.full((v,), KIND_MOVE, dtype=jnp.int32), s_i, dst,
@@ -327,20 +374,209 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
             dead = w_sel | (jnp.isfinite(bs) & ~jnp.isfinite(s_now))
             blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
             # a moved replica is gone: its whole destination row dies
-            cols = jnp.arange(b_count, dtype=jnp.int32)[None, :]
-            row_ids = (k_i * b_count)[:, None] + cols
+            cols = jnp.arange(c_dst, dtype=jnp.int32)[None, :]
+            row_ids = (k_i * c_dst)[:, None] + cols
             blk = blk.at[rows0[:, None], row_ids].set(
                 blk[rows0[:, None], row_ids] | w_sel[:, None]
             )
             return (agg_c, applied_any | jnp.any(w_sel), blk), None
 
-        init = (agg, jnp.asarray(False), jnp.zeros((v, k * b_count), dtype=bool))
+        init = (agg, jnp.asarray(False), jnp.zeros((v, k * c_dst), dtype=bool))
         (agg2, applied_any, _), _ = jax.lax.scan(
             wave, init, jnp.arange(waves, dtype=jnp.int32)
         )
         return agg2, applied_any
 
     return pair_round
+
+
+def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
+                          apply_waves: int):
+    """Swap phase for TopicReplicaDistributionGoal, run when pair-drain moves
+    stall: exchange a surplus-topic replica with a similar-load replica from
+    an under-count broker.
+
+    Why swaps: once the usage-distribution goals have converged, their
+    acceptance bands freeze most single moves (a replica can neither leave
+    its source without breaking a band lower bound nor land without breaking
+    an upper bound), but a swap's NET load transfer is the difference of two
+    replica loads — tiny when the return replica is chosen close in load —
+    so the bands' net check (acceptance.swap_tables_acceptance) passes where
+    every single move fails. The reference has no topic swap (its topic goal
+    simply leaves these states); the parity gate only requires not being
+    WORSE than the greedy, and a swap strictly reduces the topic imbalance
+    without degrading any previously-optimized goal.
+
+    Per round: the stalled surplus pairs (one per source broker, as in
+    make_pair_drain_round) x top-D under-count destination brokers for the
+    pair's topic x each destination's K lightest/heaviest return replicas,
+    validated exactly (structural legality both legs, rack safety both ways,
+    prior-goal net tables, topic-cost improvement), applied in
+    endpoint-disjoint waves.
+    """
+    p_count, r = dims.num_partitions, dims.max_rf
+    t_count, b_count = dims.num_topics, dims.num_brokers
+    v = max(1, min(n_pairs, b_count))
+    d_dst = max(1, min(d_dst, b_count))
+    k_ret = max(1, min(k_ret, p_count))
+    n = p_count * r
+    n_groups = t_count * b_count
+
+    def topic_cost_delta(gs, agg_c, t1, b, d, t2):
+        """f32[...]: topic-imbalance change of swapping one t1 replica
+        b -> d against one t2 replica d -> b (negative = improvement)."""
+        def imb(t, cnt):
+            c = cnt.astype(jnp.float32)
+            return jnp.maximum(0.0, c - gs.upper[t]) + jnp.maximum(
+                0.0, gs.lower[t] - c
+            )
+
+        c1b = agg_c.topic_replica_count[t1, b]
+        c1d = agg_c.topic_replica_count[t1, d]
+        c2d = agg_c.topic_replica_count[t2, d]
+        c2b = agg_c.topic_replica_count[t2, b]
+        delta = (
+            imb(t1, c1b - 1) - imb(t1, c1b)
+            + imb(t1, c1d + 1) - imb(t1, c1d)
+            + imb(t2, c2d - 1) - imb(t2, c2d)
+            + imb(t2, c2b + 1) - imb(t2, c2b)
+        )
+        # same-topic swaps are topic-neutral at best; exclude
+        return jnp.where(t1 == t2, jnp.float32(0.0), delta)
+
+    def validate(static, agg_c, tables, gs, p1, s1, b, p2, s2, d):
+        """(ok, improvement) for swap cells of any common shape: replica
+        (p1, s1) of broker b exchanged with (p2, s2) of broker d."""
+        from cruise_control_tpu.analyzer.acceptance import swap_tables_acceptance
+
+        a = agg_c.assignment
+        still = (a[p1, s1] == b) & (a[p2, s2] == d) & (b != d) & (p1 != p2)
+        still &= static.movable_partition[p1] & static.movable_partition[p2]
+        still &= static.replica_dst_ok[d] & static.replica_dst_ok[b]
+        still &= ~static.only_move_immigrants
+        # neither endpoint may already host the other partition
+        still &= ~jnp.any(a[p1] == d[..., None], axis=-1)
+        still &= ~jnp.any(a[p2] == b[..., None], axis=-1)
+        # rack safety both ways (minus the departing sibling when same rack)
+        rack_b = static.broker_rack[b]
+        rack_d = static.broker_rack[d]
+        same_rack = (rack_b == rack_d).astype(agg_c.rack_replica_count.dtype)
+        still &= (agg_c.rack_replica_count[p1, rack_d] - same_rack) == 0
+        still &= (agg_c.rack_replica_count[p2, rack_b] - same_rack) == 0
+        # leadership eligibility when a leader slot changes brokers
+        still &= (s1 != 0) | static.leadership_dst_ok[d]
+        still &= (s2 != 0) | static.leadership_dst_ok[b]
+        mv1 = build_selected(
+            static.part_load, a, p1, jnp.int32(KIND_MOVE), s1, d
+        )
+        mv2 = build_selected(
+            static.part_load, a, p2, jnp.int32(KIND_MOVE), s2, b
+        )
+        still &= swap_tables_acceptance(static, tables, agg_c, mv1, mv2)
+        t1 = static.topic_id[p1]
+        t2 = static.topic_id[p2]
+        improvement = -topic_cost_delta(gs, agg_c, t1, b, d, t2)
+        ok = still & (improvement > 1e-6)
+        return ok, improvement, mv1, mv2
+
+    def swap_round(static: StaticCtx, agg: Aggregates, tables, gs, rnd):
+        # same shared pair selection as the move round (dead brokers never
+        # surface as SWAP sources usefully — the return leg cannot land on a
+        # dead broker — but their 1e9 surplus rank is harmless: every cell
+        # fails validation and the pair costs one row)
+        pair_t, pair_b, pair_ok = select_surplus_pairs(
+            static, agg, tables, gs, rnd, v, t_count, b_count
+        )
+        # two candidate replicas per pair, alternated across rounds: the
+        # first may be permanently rack-blocked at every destination while
+        # the second swaps legally
+        c1p, c1s, c_found = pair_replica_picks(
+            static, agg, pair_t, pair_b, 2, t_count, b_count
+        )
+        page = (rnd % 2).astype(jnp.int32)
+        use_second = (page == 1) & c_found[:, 1]
+        p1 = jnp.where(use_second, c1p[:, 1], c1p[:, 0])
+        s1 = jnp.where(use_second, c1s[:, 1], c1s[:, 0])
+        cand_ok = c_found[:, 0] & pair_ok
+
+        dsts = topic_dst_list(
+            static, agg, tables, gs, pair_t, pair_b, rnd, d_dst, b_count
+        )
+
+        # return candidates: each destination broker's lightest and heaviest
+        # movable replicas by total load — the lightest bound the net transfer
+        # from below, the heaviest from above; exact validation picks what the
+        # bands accept
+        from cruise_control_tpu.analyzer.actions import _follower_vec, _leader_vec
+
+        p_all = jnp.arange(p_count, dtype=jnp.int32)
+        lead_l1 = jnp.sum(_leader_vec(static.part_load, p_all), axis=-1)
+        foll_l1 = jnp.sum(_follower_vec(static.part_load, p_all), axis=-1)
+        is_leader = (jnp.arange(r) == 0)[None, :]
+        load_l1 = jnp.where(is_leader, lead_l1[:, None], foll_l1[:, None])
+        k_half = max(1, k_ret // 2)
+        lp, ls, lok = broker_top_replicas(
+            static, agg, load_l1, k_half, b_count, heaviest=False
+        )
+        hp, hs, hok = broker_top_replicas(
+            static, agg, load_l1, k_ret - k_half, b_count, heaviest=True
+        )
+        ret_p = jnp.concatenate([lp, hp], axis=1)  # [B, K]
+        ret_s = jnp.concatenate([ls, hs], axis=1)
+        ret_ok = jnp.concatenate([lok, hok], axis=1)
+
+        # grid [V, D, K]
+        g_p2 = ret_p[dsts]  # [V, D, K]
+        g_s2 = ret_s[dsts]
+        g_ok = ret_ok[dsts] & cand_ok[:, None, None]
+        full = g_p2.shape
+        ok, improve, _, _ = validate(
+            static, agg, tables, gs,
+            jnp.broadcast_to(p1[:, None, None], full),
+            jnp.broadcast_to(s1[:, None, None], full),
+            jnp.broadcast_to(pair_b[:, None, None], full),
+            g_p2, g_s2,
+            jnp.broadcast_to(dsts[:, :, None], full),
+        )
+        score0 = jnp.where(ok & g_ok, improve, -jnp.inf)
+        cells = score0.reshape(v, d_dst * k_ret)
+        rows0 = jnp.arange(v, dtype=jnp.int32)
+        waves = max(1, apply_waves)
+
+        def wave(carry, w):
+            del w
+            agg_c, applied_any, blocked = carry
+            masked = jnp.where(blocked, -jnp.inf, cells)
+            ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            bs = jnp.take_along_axis(masked, ci[:, None], axis=1)[:, 0]
+            d_i = dsts[rows0, ci // k_ret]
+            p2 = g_p2[rows0, ci // k_ret, ci % k_ret]
+            s2 = g_s2[rows0, ci // k_ret, ci % k_ret]
+            ok_w, improve_w, mv1, mv2 = validate(
+                static, agg_c, tables, gs, p1, s1, pair_b, p2, s2, d_i
+            )
+            ok_w = ok_w & jnp.isfinite(bs)
+            w_sel = wave_select(
+                jnp.where(ok_w, improve_w, -jnp.inf), pair_b, d_i,
+                static.broker_host[d_i], ok_w, b_count, dims.num_hosts,
+                dst_host2=static.broker_host[pair_b],
+                parts=(p1, p2), num_partitions=p_count,
+            )
+            agg_c = apply_actions_batch(static, agg_c, mv1, w_sel)
+            agg_c = apply_actions_batch(static, agg_c, mv2, w_sel)
+            dead = w_sel | (jnp.isfinite(bs) & ~ok_w)
+            blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
+            # an applied row's replica moved: its whole row dies
+            blk = blk | (w_sel[:, None] & jnp.ones((1, d_dst * k_ret), bool))
+            return (agg_c, applied_any | jnp.any(w_sel), blk), None
+
+        init = (agg, jnp.asarray(False), jnp.zeros((v, d_dst * k_ret), bool))
+        (agg2, applied_any, _), _ = jax.lax.scan(
+            wave, init, jnp.arange(waves, dtype=jnp.int32)
+        )
+        return agg2, applied_any
+
+    return swap_round
 
 
 def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
@@ -518,9 +754,11 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
                 blk[rows0[:, None], cell_ids] | sel_mv[:, None]
             )
             if use_leadership:
-                lead_done = lead_done | sel[v:] | (
-                    jnp.isfinite(lead_s0) & ~jnp.isfinite(ls_now)
-                )
+                # only APPLIED entries die; a transiently infeasible promotion
+                # (its follower's broker filled up this wave) may become
+                # feasible again when a later wave drains that broker — all
+                # entries re-bid each wave anyway, so retrying costs nothing
+                lead_done = lead_done | sel[v:]
             return (agg_c, applied_any | jnp.any(sel), blk, lead_done), None
 
         init = (

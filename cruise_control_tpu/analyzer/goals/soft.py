@@ -284,11 +284,12 @@ class TopicReplicaDistributionGoal(Goal):
     (cc/analyzer/goals/TopicReplicaDistributionGoal.java:53)."""
 
     name = "TopicReplicaDistributionGoal"
-    #: batched engine: drain (topic, broker) surplus pairs with an exact
-    #: all-broker destination scan (analyzer.drain.make_pair_drain_round) —
-    #: per-broker replica picks starve this goal (a broker's top candidates
-    #: are mostly replicas of the same over topic) and pruned destination
-    #: lists miss the rare topic-feasible AND band-feasible destination
+    #: batched engine: drain (topic, broker) surplus pairs
+    #: (analyzer.drain.make_pair_drain_round) with round-rotated, band-aware
+    #: destination lists, plus a similar-load SWAP fallback when moves are
+    #: frozen by the prior goals' bands — per-broker replica picks starve
+    #: this goal (a broker's top candidates are mostly replicas of the same
+    #: over topic)
     pair_drain = True
 
     def prepare(self, static, agg, dims):

@@ -366,6 +366,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     use_drain = settings.batch_k > 1 or getattr(goal, "uses_swaps", False)
     drain_fn = None
     swap_fn = None
+    topic_swap_fn = None
     if use_drain:
         from cruise_control_tpu.analyzer.drain import (
             make_drain_round,
@@ -373,8 +374,16 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         )
 
         if getattr(goal, "pair_drain", False):
+            from cruise_control_tpu.analyzer.drain import make_topic_swap_round
+
             drain_fn = make_pair_drain_round(
                 goal, dims, settings.drain_src, settings.apply_waves
+            )
+            # stall fallback: band-frozen surplus pairs escape via swaps
+            # whose net load transfer the prior goals' bands accept
+            topic_swap_fn = make_topic_swap_round(
+                goal, dims, settings.drain_src, max(4, settings.drain_dst // 4),
+                8, settings.apply_waves,
             )
         else:
             drain_fn = make_drain_round(
@@ -458,6 +467,16 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                     agg2,
                 )
                 applied = applied | swap_applied
+            if topic_swap_fn is not None:
+                # band-frozen surplus pairs escape via similar-load swaps
+                # once plain topic moves stall
+                agg2, tswap_applied = jax.lax.cond(
+                    applied,
+                    lambda a: (a, jnp.asarray(False)),
+                    lambda a: topic_swap_fn(static, a, tables, gs0, rnd),
+                    agg2,
+                )
+                applied = applied | tswap_applied
             empties = jnp.where(applied, jnp.int32(0), empties + 1)
             return (agg2, rnd + 1, empties)
 

@@ -531,6 +531,16 @@ def utilization(agg: Aggregates, static: StaticCtx) -> jax.Array:
     return agg.broker_load / jnp.maximum(static.broker_capacity, 1e-9)
 
 
+def replicas_on_dead(static: StaticCtx, assignment: jax.Array) -> jax.Array:
+    """bool[P, R]: slots whose replica currently sits on a dead broker.
+
+    Unassigned slots (-1) are clamped to broker 0 for the gather and masked
+    back out — the one shared home for this subtle idiom (evacuation checks
+    in the drain engine and the goal loop's convergence test)."""
+    valid = assignment >= 0
+    return static.dead[jnp.where(valid, assignment, 0)] & valid
+
+
 def dst_hosts_partition(agg: Aggregates, p, dst) -> jax.Array:
     """bool[...]: does dst already host a replica of p (any slot)?
 

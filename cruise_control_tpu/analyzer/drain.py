@@ -629,9 +629,11 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
         # drain_contrib excludes ordinary replicas (-inf for non-violating /
         # follower slots) would otherwise rank the dead broker first as a
         # source yet nominate zero candidates from it
-        valid_slot = agg.assignment >= 0
-        on_dead = static.dead[jnp.where(valid_slot, agg.assignment, 0)] & valid_slot
-        contrib = jnp.where(on_dead, jnp.float32(1e9), contrib)
+        from cruise_control_tpu.analyzer.context import replicas_on_dead
+
+        contrib = jnp.where(
+            replicas_on_dead(static, agg.assignment), jnp.float32(1e9), contrib
+        )
 
         cand_p, cand_s, cand_ok = heavy_picks(
             static, agg, contrib, hot, k, dims.num_brokers

@@ -180,7 +180,16 @@ class CapacityGoal(Goal):
 
     def cost(self, static, gs, agg):
         excess = jnp.maximum(0.0, agg.broker_load[:, self.resource] - gs.limit)
-        return jnp.sum(jnp.where(static.alive, excess, 0.0))
+        total = jnp.sum(jnp.where(static.alive, excess, 0.0))
+        if self.resource == Resource.CPU:
+            # host-level CPU overage counts too — broker_violation/src_rank
+            # flag it, so a cost that ignored it would let convergence checks
+            # declare the goal done with host violations unrepaired
+            host_excess = jnp.maximum(
+                0.0, agg.host_cpu_load - static.host_cpu_capacity_limit
+            )
+            total = total + jnp.sum(host_excess)
+        return total
 
     def acceptance(self, static, gs, agg, act: ActionBatch):
         dres = act.dload[..., self.resource]

@@ -477,7 +477,23 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                     agg2,
                 )
                 applied = applied | tswap_applied
-            empties = jnp.where(applied, jnp.int32(0), empties + 1)
+            # a zero-cost goal with no dead-broker replicas is DONE: no
+            # action can score (every improvement criterion requires reducing
+            # out-of-range distance, and evacuation — which scores via the
+            # dead-broker bonus regardless of goal cost — has nothing left),
+            # so spending `empties_to_stall` further rounds proving emptiness
+            # — each a full grid + swap attempt — is pure waste. The check is
+            # a few aggregate-sized ops per round.
+            from cruise_control_tpu.analyzer.context import replicas_on_dead
+
+            satisfied = (goal.cost(static, gs0, agg2) <= SCORE_EPS) & ~jnp.any(
+                replicas_on_dead(static, agg2.assignment)
+            )
+            empties = jnp.where(
+                satisfied,
+                jnp.int32(empties_to_stall),
+                jnp.where(applied, jnp.int32(0), empties + 1),
+            )
             return (agg2, rnd + 1, empties)
 
         final_agg, rnd_end, empties = jax.lax.while_loop(

@@ -44,14 +44,20 @@ is sharded over all of them (jax.sharding.Mesh via parallel.sharding); on a
 single chip the mesh is skipped (a 1-device mesh only adds padding).
 
 Platform handling: the default backend (TPU) is probed in a subprocess with
-a timeout first; if its init hangs (dead axon tunnel — the round-1 failure
-mode), the run degrades to a labeled CPU number instead of dying silently.
+retries + backoff first; if its init hangs (dead axon tunnel — the round-1
+failure mode), the run degrades to a labeled CPU number instead of dying
+silently. Every compact line carries "platform" and "probeFallback" so a CPU
+fallback is impossible to miss, and when a fallback happened the tunnel is
+re-probed before each remaining config — on recovery the process re-execs
+itself so the larger configs still produce TPU numbers.
 
 Usage: python bench.py [--smoke]        # --smoke = config 1 only, fast
 Env overrides: BENCH_CONFIG (single config 1-5), BENCH_SEED,
-BENCH_PROBE_TIMEOUT_S, BENCH_STAGES (comma list, default "1,2,3,4,5"),
+BENCH_PROBE_TIMEOUT_S, BENCH_PROBE_RETRIES (default 3), BENCH_REPROBE=0 to
+disable mid-run re-probing, BENCH_STAGES (comma list, default "1,2,3,4,5"),
 BENCH_PARITY=0 to skip the greedy passes, BENCH_PARITY5_BROKERS (parity
-model size for config 5, default 260).
+model size for config 5, default 520), BENCH_GREEDY_CEILING (greedy
+cost-scaled round-cap ceiling, default 8192).
 """
 
 from __future__ import annotations
@@ -65,6 +71,15 @@ import traceback
 
 DETAIL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAIL.json")
 _DETAIL: dict = {"configs": []}
+if os.environ.get("BENCH_DETAIL_APPEND") == "1":
+    # set by the mid-run re-exec (a recovered TPU tunnel): earlier configs'
+    # detail records were written by the previous incarnation of this process
+    try:
+        with open(DETAIL_PATH) as _f:
+            _DETAIL = json.load(_f)
+        _DETAIL.setdefault("configs", [])
+    except (OSError, ValueError):
+        pass
 
 
 def log(msg: str) -> None:
@@ -119,10 +134,15 @@ def _settings(batched: bool):
     # (AbstractGoal.maybeApplyBalancingAction); resource-distribution goals
     # use the same reference-shaped drain/fill kernel in both modes but run
     # here to deeper convergence (4x the rounds), making the greedy
-    # reference a STRICTLY stronger baseline on those goals.
+    # reference a STRICTLY stronger baseline on those goals. The round cap
+    # scales with each goal's entry cost (one action ~ one cost unit at
+    # batch_k=1) so large goals CONVERGE instead of comparing caps; goals the
+    # ceiling still binds are reported as greedyCapBoundGoals.
+    ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "8192"))
     return OptimizerSettings(batch_k=1, max_rounds_per_goal=512, num_dst_candidates=16,
                              num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
-                             chunk_rounds=chunk * 4 if chunk else 0)
+                             chunk_rounds=chunk * 4 if chunk else 0,
+                             cost_scaled_rounds=1.5, rounds_ceiling=ceiling)
 
 
 def _goal_table(result):
@@ -134,6 +154,7 @@ def _goal_table(result):
             "costBefore": round(g.cost_before, 6),
             "costAfter": round(g.cost_after, 6),
             "rounds": g.rounds,
+            "converged": g.converged,
             "durationS": round(g.duration_s, 4),
         }
         for g in result.goal_results
@@ -197,6 +218,9 @@ def _parity_block(cfg_id, batched_result, greedy_wall, greedy_result):
         if bg.violated_brokers_after > gg.violated_brokers_after + PARITY_COUNT_SLACK:
             count_worse.append(bg.name)
     ok = not worse and not regressed and not count_worse
+    # goals where the greedy baseline ran out of rounds before stalling: its
+    # scores there reflect the cap, not search quality (VERDICT r4 weak #3)
+    cap_bound = [g.name for g in greedy_result.goal_results if not g.converged]
     block = {
         "greedyWallS": round(greedy_wall, 3),
         "greedyViolatedAfter": sorted(greedy_after),
@@ -205,6 +229,7 @@ def _parity_block(cfg_id, batched_result, greedy_wall, greedy_result):
         "costRegressedGoals": regressed,  # must be []
         "countRegressedGoals": count_worse,  # must be [] (> +3 brokers)
         "costAfterDeltaVsGreedy": cost_delta,  # negative = batched better
+        "greedyCapBoundGoals": cap_bound,  # [] = greedy fully converged
         "parityOk": ok,
         "greedyGoals": _goal_table(greedy_result),
     }
@@ -224,7 +249,7 @@ def _parity5(seed: int, mesh, batched_settings) -> dict:
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
     from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
 
-    brokers = int(os.environ.get("BENCH_PARITY5_BROKERS", "260"))
+    brokers = int(os.environ.get("BENCH_PARITY5_BROKERS", "520"))
     prop = ClusterProperty(
         num_racks=52, num_brokers=brokers, num_topics=max(50, (brokers * 20) // 13),
         mean_partitions_per_topic=50.0, replication_factor=3,
@@ -245,7 +270,8 @@ def _parity5(seed: int, mesh, batched_settings) -> dict:
     return block
 
 
-def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> None:
+def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
+               probe_fallback: bool = False) -> None:
     import numpy as np
 
     from cruise_control_tpu.analyzer.context import OptimizationOptions
@@ -295,6 +321,8 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> Non
             ),
             "value": round(wall, 3),
             "unit": "s",
+            "platform": platform,
+            "probeFallback": probe_fallback,
             "addWallS": round(add_wall, 3),
             "removeWallS": round(drain_wall, 3),
             "removeEvacuatedCleanly": evacuated,
@@ -308,8 +336,12 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> Non
             detail["parity"] = _parity_block(cfg_id, add_result, greedy_wall, greedy_result)
             payload["parityOk"] = detail["parity"]["parityOk"]
             # the greedy reference covers the add pass only; scope the ratio
-            # to the same measurement so value * vs_baseline stays meaningful
-            payload["vs_baseline"] = round(greedy_wall / max(add_wall, 1e-9), 3)
+            # to the same measurement so value * vs_baseline stays meaningful.
+            # A parity failure zeroes vs_baseline (the module contract: it IS
+            # a bench failure); the raw ratio stays in speedupVsGreedy.
+            ratio = round(greedy_wall / max(add_wall, 1e-9), 3)
+            payload["speedupVsGreedy"] = ratio
+            payload["vs_baseline"] = ratio if payload["parityOk"] else 0.0
             payload["vsBaselineScope"] = "add-broker pass (greedyWallS / addWallS)"
         else:
             payload["vs_baseline"] = 0.0
@@ -336,6 +368,8 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> Non
         ),
         "value": round(wall, 3),
         "unit": "s",
+        "platform": platform,
+        "probeFallback": probe_fallback,
         "moves": result.num_replica_moves,
         "leadershipMoves": result.num_leadership_moves,
         "violatedAfterCount": len(result.violated_goals_after),
@@ -362,7 +396,11 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh) -> Non
         )
         detail["parity"] = _parity_block(cfg_id, result, greedy_wall, greedy_result)
         payload["parityOk"] = detail["parity"]["parityOk"]
-        payload["vs_baseline"] = round(greedy_wall / max(wall, 1e-9), 3)
+        # a parity failure zeroes vs_baseline on EVERY config (the module
+        # contract); the raw speed ratio stays in speedupVsGreedy
+        ratio = round(greedy_wall / max(wall, 1e-9), 3)
+        payload["speedupVsGreedy"] = ratio
+        payload["vs_baseline"] = ratio if payload["parityOk"] else 0.0
     else:
         payload["vs_baseline"] = 0.0
     emit(payload, detail)
@@ -382,7 +420,10 @@ def main() -> None:
 
     from cruise_control_tpu.platform_probe import ensure_live_backend
 
-    ensure_live_backend(timeout_s=probe_timeout, log=log)
+    probe = ensure_live_backend(
+        timeout_s=probe_timeout, log=log,
+        retries=int(os.environ.get("BENCH_PROBE_RETRIES", "3")),
+    )
 
     from cruise_control_tpu.compile_cache import enable_persistent_cache
 
@@ -412,9 +453,36 @@ def main() -> None:
         stages = [int(s) for s in os.environ.get("BENCH_STAGES", "1,2,3,4,5").split(",")]
 
     completed = 0
-    for cfg_id in stages:
+    for i, cfg_id in enumerate(stages):
+        if probe.fallback and i > 0 and os.environ.get("BENCH_REPROBE", "1") != "0":
+            # the run degraded to CPU at startup; a tunnel that recovers
+            # mid-run should still produce TPU numbers for the remaining
+            # (larger) configs. The in-process backend cannot be swapped
+            # after init, so on a live re-probe the process re-execs itself
+            # for the remaining stages (stdout fd survives exec; the detail
+            # file is appended via BENCH_DETAIL_APPEND).
+            from cruise_control_tpu.platform_probe import probe_only
+
+            log(f"re-probing default backend before config {cfg_id}...")
+            name = probe_only(timeout_s=min(probe_timeout, 60.0))
+            if name is not None and name != "cpu":
+                remaining = ",".join(str(s) for s in stages[i:])
+                log(f"default backend recovered ({name}); re-exec for stages {remaining}")
+                env = dict(os.environ)
+                env.pop("JAX_PLATFORMS", None)  # drop our cpu pin
+                env["BENCH_STAGES"] = remaining
+                env["BENCH_DETAIL_APPEND"] = "1"
+                env.pop("BENCH_CONFIG", None)
+                sys.stderr.flush()
+                sys.stdout.flush()
+                os.execve(
+                    sys.executable,
+                    [sys.executable, os.path.abspath(__file__)], env,
+                )
+            log("default backend still dead; continuing on cpu")
         try:
-            run_config(cfg_id, seed, platform, parity=parity, mesh=mesh)
+            run_config(cfg_id, seed, platform, parity=parity, mesh=mesh,
+                       probe_fallback=probe.fallback)
             completed += 1
         except Exception:
             log(f"[config {cfg_id}] FAILED:\n{traceback.format_exc()}")
@@ -427,6 +495,8 @@ def main() -> None:
                 "value": -1.0,
                 "unit": "s",
                 "vs_baseline": 0.0,
+                "platform": platform,
+                "probeFallback": probe.fallback,
             }
         )
         sys.exit(1)

@@ -46,13 +46,28 @@ from cruise_control_tpu.analyzer.actions import (
     KIND_MOVE,
     build_selected,
 )
-from cruise_control_tpu.analyzer.acceptance import score_batch
+from cruise_control_tpu.analyzer.acceptance import band_move_acceptance, score_batch
 from cruise_control_tpu.analyzer.context import (
     Aggregates,
     StaticCtx,
     apply_actions_batch,
     wave_select,
 )
+
+
+def round_jitter(n: int, rnd) -> jax.Array:
+    """f32[n] in [0.5, 1): round-seeded multiplicative jitter for candidate
+    rankings. Walking the ranking across rounds keeps a uniformly-infeasible
+    top-K from starving a goal — candidate ORDER is free because every
+    nomination is exactly re-validated before applying. The constants form
+    one coupled recipe shared by every rotated selection site (the goal-loop
+    drain rotation and the leadership-swap candidate picks must stay in the
+    same family so their slices interleave, not collide)."""
+    ids = jnp.arange(n, dtype=jnp.uint32)
+    h = (ids + jnp.asarray(rnd).astype(jnp.uint32) * jnp.uint32(40503)) * jnp.uint32(
+        2654435761
+    )
+    return 0.5 + 0.5 * (h >> 8).astype(jnp.float32) / float(1 << 24)
 
 
 def broker_top_replicas(static: StaticCtx, agg: Aggregates, contrib: jax.Array,
@@ -457,12 +472,17 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
         # neither endpoint may already host the other partition
         still &= ~jnp.any(a[p1] == d[..., None], axis=-1)
         still &= ~jnp.any(a[p2] == b[..., None], axis=-1)
-        # rack safety both ways (minus the departing sibling when same rack)
+        # rack safety both ways (minus the departing sibling when same rack),
+        # enforced only when RackAwareGoal actually ran before this goal —
+        # unconditional checking would silently disable the swap fallback in
+        # rack-colocated layouts where the rack goal is not in the stack
         rack_b = static.broker_rack[b]
         rack_d = static.broker_rack[d]
         same_rack = (rack_b == rack_d).astype(agg_c.rack_replica_count.dtype)
-        still &= (agg_c.rack_replica_count[p1, rack_d] - same_rack) == 0
-        still &= (agg_c.rack_replica_count[p2, rack_b] - same_rack) == 0
+        rack_safe = ((agg_c.rack_replica_count[p1, rack_d] - same_rack) == 0) & (
+            (agg_c.rack_replica_count[p2, rack_b] - same_rack) == 0
+        )
+        still &= rack_safe | ~tables.rack_enabled
         # leadership eligibility when a leader slot changes brokers
         still &= (s1 != 0) | static.leadership_dst_ok[d]
         still &= (s2 != 0) | static.leadership_dst_ok[b]
@@ -577,6 +597,211 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
         return agg2, applied_any
 
     return swap_round
+
+
+def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
+                               apply_waves: int):
+    """Leadership-swap fallback for leader-load goals (LeaderBytesIn): when
+    plain promotions stall, EXCHANGE leadership between an over-bound broker
+    and a neighbor — promote a heavy leader p1 of over-broker b to its
+    follower at d, and promote a light leader p2 of d to its follower at b.
+
+    Why swaps: near convergence the leader-count goal's bounds (hi_lead /
+    lo_lead, cc/analyzer/goals/LeaderReplicaDistributionGoal.java) veto every
+    single promotion (+-1 leader at each endpoint), and the usage bands veto
+    the full leader-load transfer. A leadership swap is COUNT-NEUTRAL at both
+    endpoints, and its net load transfer is the difference of the two
+    partitions' leader loads — tiny when the return partition is chosen close
+    in weight — so both table families pass where every single action fails.
+    The reference has no leadership swap (LeaderBytesInDistributionGoal.java
+    :39 only relocates leadership one partition at a time and simply leaves
+    these states); the parity gate only requires not being worse.
+
+    Per round: top-V over-bound sources by src_rank x their K1 heaviest
+    leaders x each leader's R-1 follower brokers d x d-leader return
+    candidates (the K2 lightest follower slots AT b by their partition's
+    leader weight, joined on leader == d), validated exactly (structural,
+    prior-goal net tables, goal-cost improvement), applied in
+    endpoint-disjoint waves.
+    """
+    p_count, r = dims.num_partitions, dims.max_rf
+    b_count = dims.num_brokers
+    v = max(1, min(n_src, b_count))
+    k1 = max(1, min(k_out, p_count))
+    k2 = max(1, min(k_in, p_count))
+    r_f = r - 1  # follower slots per candidate leader
+
+    from cruise_control_tpu.common.resources import PartMetric
+
+    def net_tables_ok(static, tables, agg_c, b, d, net_load, net_lnw):
+        """Net-effect table check for a leadership swap b <-> d: per-resource
+        load band + hard box + leader bytes-in + host CPU. Leader counts,
+        replica counts, topic counts, potential NW_OUT and rack safety are
+        unchanged by construction (both legs transfer leadership only)."""
+        inc = net_load > 0.0
+        ok = jnp.all(
+            ~inc | (agg_c.broker_load[d] + net_load <= tables.hi_load[d]),
+            axis=-1,
+        )
+        ok &= jnp.all(
+            (net_load >= 0.0)
+            | (agg_c.broker_load[b] - net_load <= tables.hi_load[b]),
+            axis=-1,
+        )
+        not_dead = jnp.zeros(jnp.broadcast_shapes(b.shape, d.shape), dtype=bool)
+        ok &= band_move_acceptance(tables, agg_c, b, d, net_load, not_dead)
+        ok &= (net_lnw <= 0.0) | (
+            agg_c.leader_nw_in[d] + net_lnw <= tables.hi_lnw[d]
+        )
+        ok &= (net_lnw >= 0.0) | (
+            agg_c.leader_nw_in[b] - net_lnw <= tables.hi_lnw[b]
+        )
+        dcpu = net_load[..., 0]
+        host_b = static.broker_host[b]
+        host_d = static.broker_host[d]
+        same_host = host_b == host_d
+        ok &= same_host | (dcpu <= 0.0) | (
+            agg_c.host_cpu_load[host_d] + dcpu <= tables.hi_host_cpu[host_d]
+        )
+        ok &= same_host | (dcpu >= 0.0) | (
+            agg_c.host_cpu_load[host_b] - dcpu <= tables.hi_host_cpu[host_b]
+        )
+        return ok
+
+    def validate(static, agg_c, tables, gs, p1, s1, b, p2, s2, d):
+        """(ok, improvement) for swap cells of any common shape: leadership
+        of p1 moves b -> d (promote p1's follower slot s1 at d) while
+        leadership of p2 moves d -> b (promote p2's follower slot s2 at b)."""
+        a = agg_c.assignment
+        still = (a[p1, 0] == b) & (a[p1, s1] == d)
+        still &= (a[p2, 0] == d) & (a[p2, s2] == b)
+        still &= (b != d) & (p1 != p2) & (s1 >= 1) & (s2 >= 1)
+        still &= static.movable_partition[p1] & static.movable_partition[p2]
+        still &= static.leadership_dst_ok[d] & static.leadership_dst_ok[b]
+        still &= ~static.only_move_immigrants
+        act1 = build_selected(
+            static.part_load, a, p1, jnp.int32(KIND_LEADERSHIP), s1, d
+        )
+        act2 = build_selected(
+            static.part_load, a, p2, jnp.int32(KIND_LEADERSHIP), s2, b
+        )
+        net_load = act1.dload - act2.dload  # [..., 4] net gain at d
+        net_lnw = act1.dleader_nw_in - act2.dleader_nw_in
+        still &= net_tables_ok(static, tables, agg_c, b, d, net_load, net_lnw)
+        # goal improvement on the two touched brokers (cost is a sum of
+        # per-broker out-of-window distances, so the delta is local)
+        from cruise_control_tpu.analyzer.goals.base import imbalance
+
+        lnw_b = agg_c.leader_nw_in[b]
+        lnw_d = agg_c.leader_nw_in[d]
+        before = imbalance(lnw_b, gs.lower, gs.upper) + imbalance(
+            lnw_d, gs.lower, gs.upper
+        )
+        after = imbalance(lnw_b - net_lnw, gs.lower, gs.upper) + imbalance(
+            lnw_d + net_lnw, gs.lower, gs.upper
+        )
+        improvement = before - after
+        ok = still & (improvement > 1e-6)
+        return ok, improvement, act1, act2
+
+    def lead_swap_round(static: StaticCtx, agg: Aggregates, tables, gs, rnd):
+        rank = goal.src_rank(static, gs, agg)
+        # dead brokers never need swaps (evacuation moves handle them) and
+        # cannot receive the return promotion; exclude outright
+        rank = jnp.where(static.dead, -jnp.inf, rank)
+        _, hot = jax.lax.top_k(rank, v)
+        hot = hot.astype(jnp.int32)
+        hot_ok = jnp.isfinite(rank[hot])
+
+        # K1 heaviest leaders per source (drain_contrib is finite only on
+        # leader slots for leader-load goals), round-jittered so a uniformly
+        # frozen head cannot starve the fallback
+        contrib = goal.drain_contrib(static, gs, agg)
+        rot = round_jitter(p_count, rnd)
+        contrib = contrib * rot[:, None]
+        c1p, c1s, c1ok = heavy_picks(static, agg, contrib, hot, k1, b_count)
+        c1ok = c1ok & hot_ok[:, None]
+
+        # K2 return candidates per source: follower slots AT the source whose
+        # partition's leader (somewhere else) is LIGHT — promoting one back
+        # into the source is the swap's second leg. Selection weight is the
+        # partition's leader-borne goal metric; the join on the first leg's
+        # destination happens in the grid.
+        w_all = static.part_load[:, PartMetric.NW_IN_LEADER]
+        is_follower = (jnp.arange(r) >= 1)[None, :]
+        ret_contrib = jnp.where(is_follower, w_all[:, None], -jnp.inf)
+        ret_contrib = ret_contrib * rot[:, None]
+        c2p, c2s, c2ok = light_picks(static, agg, ret_contrib, hot, k2, b_count)
+
+        # grid [V, K1, R-1, K2]: first leg (p1 -> its s1-th follower broker),
+        # joined against return candidates whose leader IS that broker
+        full = (v, k1, r_f, k2)
+        g_p1 = jnp.broadcast_to(c1p[:, :, None, None], full)
+        s1_all = jnp.arange(1, r, dtype=jnp.int32)
+        g_s1 = jnp.broadcast_to(s1_all[None, None, :, None], full)
+        g_b = jnp.broadcast_to(hot[:, None, None, None], full)
+        g_p2 = jnp.broadcast_to(c2p[:, None, None, :], full)
+        g_s2 = jnp.broadcast_to(c2s[:, None, None, :], full)
+        g_d = agg.assignment[g_p1, g_s1]  # first-leg destination
+        g_ok = (
+            c1ok[:, :, None, None]
+            & c2ok[:, None, None, :]
+            & (g_d >= 0)
+            & (agg.assignment[g_p2, 0] == g_d)  # the join
+        )
+        ok, improve, _, _ = validate(
+            static, agg, tables, gs, g_p1, g_s1, g_b, g_p2, g_s2,
+            jnp.maximum(g_d, 0),
+        )
+        score0 = jnp.where(ok & g_ok, improve, -jnp.inf)
+        n_cells = k1 * r_f * k2
+        cells = score0.reshape(v, n_cells)
+        rows0 = jnp.arange(v, dtype=jnp.int32)
+        waves = max(1, apply_waves)
+
+        def cell_pick(ci):
+            i1 = ci // (r_f * k2)
+            i_s = (ci // k2) % r_f
+            i2 = ci % k2
+            p1 = c1p[rows0, i1]
+            s1 = s1_all[i_s]
+            p2 = c2p[rows0, i2]
+            s2 = c2s[rows0, i2]
+            return p1, s1, p2, s2
+
+        def wave(carry, w):
+            del w
+            agg_c, applied_any, blocked = carry
+            masked = jnp.where(blocked, -jnp.inf, cells)
+            ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
+            bs = jnp.take_along_axis(masked, ci[:, None], axis=1)[:, 0]
+            p1, s1, p2, s2 = cell_pick(ci)
+            d_i = jnp.maximum(agg_c.assignment[p1, s1], 0)
+            ok_w, improve_w, act1, act2 = validate(
+                static, agg_c, tables, gs, p1, s1, hot, p2, s2, d_i
+            )
+            ok_w = ok_w & jnp.isfinite(bs)
+            w_sel = wave_select(
+                jnp.where(ok_w, improve_w, -jnp.inf), hot, d_i,
+                static.broker_host[d_i], ok_w, b_count, dims.num_hosts,
+                dst_host2=static.broker_host[hot],
+                parts=(p1, p2), num_partitions=p_count,
+            )
+            agg_c = apply_actions_batch(static, agg_c, act1, w_sel)
+            agg_c = apply_actions_batch(static, agg_c, act2, w_sel)
+            dead = w_sel | (jnp.isfinite(bs) & ~ok_w)
+            blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
+            # an applied row's leadership moved: its whole row dies
+            blk = blk | (w_sel[:, None] & jnp.ones((1, n_cells), bool))
+            return (agg_c, applied_any | jnp.any(w_sel), blk), None
+
+        init = (agg, jnp.asarray(False), jnp.zeros((v, n_cells), bool))
+        (agg2, applied_any, _), _ = jax.lax.scan(
+            wave, init, jnp.arange(waves, dtype=jnp.int32)
+        )
+        return agg2, applied_any
+
+    return lead_swap_round
 
 
 def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,

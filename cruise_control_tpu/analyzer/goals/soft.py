@@ -410,6 +410,11 @@ class LeaderBytesInDistributionGoal(Goal):
     name = "LeaderBytesInDistributionGoal"
     uses_leadership = True
     rotate_drain_candidates = True
+    #: stall fallback: count-neutral leadership exchanges with a similar-load
+    #: return partition (drain.make_leadership_swap_round) — near convergence
+    #: the leader-count bounds veto every +-1 promotion and the usage bands
+    #: veto the full transfer, but a swap's NET transfer passes both
+    leadership_swap = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)

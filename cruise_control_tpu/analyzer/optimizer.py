@@ -99,6 +99,16 @@ class OptimizerSettings:
 
     batch_k: int = 64  # shortlisted actions per round; 1 = faithful greedy
     max_rounds_per_goal: int = 64
+    #: > 0: a goal's round cap scales with its ENTRY cost — cap_g =
+    #: clip(ceil(cost_scaled_rounds * cost_at_entry), max_rounds_per_goal,
+    #: rounds_ceiling). The faithful greedy applies ~one cost unit per round
+    #: (batch_k=1), so a fixed cap silently truncates large goals (a 260-broker
+    #: topic goal needs ~2,300 single actions); cost-scaling makes the greedy
+    #: baseline CONVERGE where the budget allows and the `converged` metric
+    #: reports where the ceiling still bound. 0 = fixed cap (default).
+    cost_scaled_rounds: float = 0.0
+    #: hard ceiling on any goal's rounds when cost_scaled_rounds > 0
+    rounds_ceiling: int = 8192
     num_dst_candidates: int = 16  # rack-representative destination brokers
     #: swap search (ResourceDistributionGoal rebalanceBySwapping* analog):
     #: hot/cold broker pairs per round x candidate replicas per broker
@@ -367,6 +377,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     drain_fn = None
     swap_fn = None
     topic_swap_fn = None
+    lead_swap_fn = None
     if use_drain:
         from cruise_control_tpu.analyzer.drain import (
             make_drain_round,
@@ -390,6 +401,17 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 goal, dims, settings.drain_src, settings.drain_per_broker,
                 settings.drain_dst, settings.apply_waves,
             )
+    if getattr(goal, "leadership_swap", False) and dims.max_rf >= 2:
+        from cruise_control_tpu.analyzer.drain import make_leadership_swap_round
+
+        # stall fallback for leader-load goals: count-neutral leadership
+        # exchanges whose NET transfer the prior goals' bounds accept where
+        # every single promotion is frozen (runs in greedy parity mode too —
+        # it strictly improves this goal's cost and is a legal action
+        # composition under every previously-optimized goal's bounds)
+        lead_swap_fn = make_leadership_swap_round(
+            goal, dims, settings.drain_src, 4, 8, settings.apply_waves
+        )
     if getattr(goal, "uses_swaps", False):
         from cruise_control_tpu.analyzer.swaps import make_swap_round
 
@@ -427,6 +449,15 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         gs0 = goal.prepare(static, agg, dims)
         if budget is None:
             budget = jnp.int32(settings.max_rounds_per_goal)
+            if settings.cost_scaled_rounds > 0:
+                # clip in FLOAT before the int cast: byte-denominated goal
+                # costs overflow int32 and would wrap the cap back down
+                scaled = jnp.clip(
+                    jnp.ceil(settings.cost_scaled_rounds * goal.cost(static, gs0, agg)),
+                    budget.astype(jnp.float32),
+                    jnp.float32(settings.rounds_ceiling),
+                )
+                budget = scaled.astype(jnp.int32)
         if rnd_base is None:
             rnd_base = jnp.int32(0)
         if empties0 is None:
@@ -443,16 +474,13 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 # round and (on stall) the swap search
                 contrib = goal.drain_contrib(static, gs0, agg_c)
                 if getattr(goal, "rotate_drain_candidates", False):
-                    # round-seeded multiplicative jitter in [0.5, 1): walks
-                    # the candidate ranking so a uniformly-infeasible top-K
-                    # cannot starve the goal (ordering is free — every
+                    # round-seeded jitter walks the candidate ranking so a
+                    # uniformly-infeasible top-K cannot starve the goal
+                    # (drain.round_jitter; ordering is free — every
                     # nomination is exactly re-validated before applying)
-                    p_ids = jnp.arange(contrib.shape[0], dtype=jnp.uint32)
-                    h = (p_ids + rnd.astype(jnp.uint32) * jnp.uint32(40503)) * jnp.uint32(
-                        2654435761
-                    )
-                    rot = (h >> 8).astype(jnp.float32) / float(1 << 24)
-                    contrib = contrib * (0.5 + 0.5 * rot)[:, None]
+                    from cruise_control_tpu.analyzer.drain import round_jitter
+
+                    contrib = contrib * round_jitter(contrib.shape[0], rnd)[:, None]
                 agg2, applied = drain_fn(static, agg_c, tables, gs0, contrib, rnd)
             else:
                 agg2, applied = one_round(static, agg_c, tables)
@@ -477,6 +505,16 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                     agg2,
                 )
                 applied = applied | tswap_applied
+            if lead_swap_fn is not None:
+                # count-neutral leadership exchanges once plain promotions
+                # and moves stall (drain.make_leadership_swap_round)
+                agg2, lswap_applied = jax.lax.cond(
+                    applied,
+                    lambda a: (a, jnp.asarray(False)),
+                    lambda a: lead_swap_fn(static, a, tables, gs0, rnd),
+                    agg2,
+                )
+                applied = applied | lswap_applied
             # a zero-cost goal with no dead-broker replicas is DONE: no
             # action can score (every improvement criterion requires reducing
             # out-of-range distance, and evacuation — which scores via the
@@ -517,6 +555,11 @@ class StackMetrics(NamedTuple):
     cost_before: jax.Array  # f32[G]
     cost_after: jax.Array  # f32[G]
     rounds: jax.Array  # i32[G]
+    #: True when the goal STALLED (no more applicable actions) rather than
+    #: exhausting its round cap — a False entry means the cap bound the
+    #: search, which the bench's parity block reports (a cap-bound greedy
+    #: baseline compares caps, not search quality)
+    converged: jax.Array  # bool[G]
 
 
 def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
@@ -536,16 +579,17 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
 
     def stack_step(static: StaticCtx, agg: Aggregates):
         tables = empty_tables(dims)
-        vb, va, cb, ca, rs = [], [], [], [], []
+        vb, va, cb, ca, rs, cv = [], [], [], [], [], []
         for goal, loop in zip(goals, loops):
             gs0 = goal.prepare(static, agg, dims)
             vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
             cb.append(goal.cost(static, gs0, agg).astype(jnp.float32))
-            agg, rounds, _ = loop(static, agg, tables)
+            agg, rounds, empties = loop(static, agg, tables)
             gs1 = goal.prepare(static, agg, dims)
             va.append(jnp.sum(goal.broker_violation(static, gs1, agg)).astype(jnp.int32))
             ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
             rs.append(rounds)
+            cv.append(empties >= loop.empties_to_stall)
             tables = goal.contribute_acceptance(static, gs1, tables)
         metrics = StackMetrics(
             violated_before=jnp.stack(vb),
@@ -553,6 +597,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             cost_before=jnp.stack(cb),
             cost_after=jnp.stack(ca),
             rounds=jnp.stack(rs),
+            converged=jnp.stack(cv),
         )
         return agg, metrics
 
@@ -634,14 +679,28 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                         metrics_b.cost_before,
                     ),
                 )
-                budget_g = jnp.minimum(left, cap - rig)
+                cap_g = jnp.int32(cap)
+                if settings.cost_scaled_rounds > 0:
+                    # scale with the goal's ORIGINAL entry cost (recorded in
+                    # cost_before the first time the goal runs, stable across
+                    # chunk-boundary re-entries); clip in FLOAT before the
+                    # int cast — byte-denominated costs overflow int32
+                    scaled = jnp.clip(
+                        jnp.ceil(
+                            settings.cost_scaled_rounds * metrics_b.cost_before[gi]
+                        ),
+                        cap_g.astype(jnp.float32),
+                        jnp.float32(settings.rounds_ceiling),
+                    )
+                    cap_g = scaled.astype(jnp.int32)
+                budget_g = jnp.minimum(left, cap_g - rig)
                 agg2, rounds, emp2 = loop(
                     static, agg_b, tables_b, budget_g,
                     rnd_base=rig, empties0=emp,
                 )
                 rig2 = rig + rounds
                 stalled = emp2 >= loop.empties_to_stall
-                done_goal = stalled | (rig2 >= cap)
+                done_goal = stalled | (rig2 >= cap_g)
                 gs_out = goal.prepare(static, agg2, dims)
                 viol_out = jnp.sum(
                     goal.broker_violation(static, gs_out, agg2)
@@ -655,6 +714,7 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                     violated_after=metrics_b.violated_after.at[gi].set(viol_out),
                     cost_after=metrics_b.cost_after.at[gi].set(cost_out),
                     rounds=metrics_b.rounds.at[gi].set(rig2),
+                    converged=metrics_b.converged.at[gi].set(stalled),
                 )
                 gi2 = jnp.where(done_goal, gi + 1, gi)
                 rig2 = jnp.where(done_goal, jnp.int32(0), rig2)
@@ -692,6 +752,7 @@ def empty_stack_metrics(n_goals: int) -> StackMetrics:
         cost_before=jnp.zeros((n_goals,), jnp.float32),
         cost_after=jnp.zeros((n_goals,), jnp.float32),
         rounds=jnp.zeros((n_goals,), jnp.int32),
+        converged=jnp.zeros((n_goals,), bool),
     )
 
 
@@ -793,6 +854,8 @@ class GoalResult:
     cost_after: float
     rounds: int
     duration_s: float
+    #: False = the round cap bound the search before the goal stalled
+    converged: bool = True
 
 
 @dataclasses.dataclass
@@ -838,6 +901,7 @@ class OptimizerResult:
                     "costBefore": g.cost_before,
                     "costAfter": g.cost_after,
                     "rounds": g.rounds,
+                    "converged": g.converged,
                     "durationS": round(g.duration_s, 4),
                 }
                 for g in self.goal_results
@@ -1111,6 +1175,7 @@ class GoalOptimizer:
                 cost_before=float(metrics.cost_before[i]),
                 cost_after=float(metrics.cost_after[i]),
                 rounds=int(metrics.rounds[i]),
+                converged=bool(metrics.converged[i]),
                 # chunked mode measures per-goal wall-clock directly; inside
                 # one fused XLA call it is not observable, so attribute the
                 # stack wall by round share

@@ -59,12 +59,12 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
 
     `tables` are the merged acceptance bounds of the already-optimized goals
     (analyzer.acceptance): every candidate swap's NET effect must pass them,
-    the same invariant the move path enforces per candidate. `runs` are the
-    round's shared sorted replica runs (analyzer.drain.replica_runs, built
-    with this goal's per-resource contribution): the heaviest replicas of a
-    hot broker are the head of its run, the lightest of a cold broker its
-    tail — one shared sort replaces per-broker top_k searches over the whole
-    replica axis."""
+    the same invariant the move path enforces per candidate. `contrib_in` is
+    the goal's per-replica drain priority for the CURRENT aggregates
+    (goal.drain_contrib, shared with the drain round): heavy_picks reads a
+    hot broker's top-k heaviest candidates from it and light_picks a cold
+    broker's k lightest — sort-free segment passes instead of per-broker
+    top_k searches over the whole replica axis."""
     res = goal.resource
     p_count, r = dims.num_partitions, dims.max_rf
     n_pairs = max(1, min(n_pairs, dims.num_brokers // 2 or 1))
@@ -130,7 +130,10 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         ok &= ~jnp.any(agg.assignment[hp[:, None, :, None]] == cold_b[..., None], axis=-1)
         ok &= ~jnp.any(agg.assignment[cp[None, :, None, :]] == hot_b[..., None], axis=-1)
 
-        # rack safety for both directions (RackAwareGoal acceptance)
+        # rack safety for both directions, only when RackAwareGoal actually
+        # ran before this goal (tables_acceptance gates the move path the
+        # same way) — unconditional checking would freeze swaps in
+        # rack-colocated layouts with no rack goal in the stack
         rack_hot = static.broker_rack[hot][:, None, None, None]
         rack_cold = static.broker_rack[cold][None, :, None, None]
         same_rack = rack_hot == rack_cold
@@ -138,11 +141,12 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
         cnt1 = agg.rack_replica_count[
             jnp.broadcast_to(hp[:, None, :, None], full), jnp.broadcast_to(rack_cold, full)
         ]
-        ok &= (cnt1 - same_rack.astype(cnt1.dtype)) == 0
+        rack_safe = (cnt1 - same_rack.astype(cnt1.dtype)) == 0
         cnt2 = agg.rack_replica_count[
             jnp.broadcast_to(cp[None, :, None, :], full), jnp.broadcast_to(rack_hot, full)
         ]
-        ok &= (cnt2 - same_rack.astype(cnt2.dtype)) == 0
+        rack_safe &= (cnt2 - same_rack.astype(cnt2.dtype)) == 0
+        ok &= rack_safe | ~tables.rack_enabled
 
         # leadership eligibility when a leader slot changes brokers
         ok &= (hs[:, None, :, None] != 0) | static.leadership_dst_ok[cold][None, :, None, None]
@@ -251,8 +255,10 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
             rack_h = static.broker_rack[h]
             rack_c = static.broker_rack[c]
             same_rack = (rack_h == rack_c).astype(agg_c.rack_replica_count.dtype)
-            still &= (agg_c.rack_replica_count[p1, rack_c] - same_rack) == 0
-            still &= (agg_c.rack_replica_count[p2, rack_h] - same_rack) == 0
+            rack_safe = ((agg_c.rack_replica_count[p1, rack_c] - same_rack) == 0) & (
+                (agg_c.rack_replica_count[p2, rack_h] - same_rack) == 0
+            )
+            still &= rack_safe | ~tables.rack_enabled
             u_h2 = agg_c.broker_load[h, res] / cap[h]
             u_c2 = agg_c.broker_load[c, res] / cap[c]
             d = contrib[p1, s1] - contrib[p2, s2]

@@ -1,11 +1,21 @@
 """The framework's main configuration.
 
 Re-creates the reference's `KafkaCruiseControlConfig`
-(cc/config/KafkaCruiseControlConfig.java, 100 keys) with the same key names and
-defaults for everything this framework supports, so an operator's
+(cc/config/KafkaCruiseControlConfig.java, ~100 keys) with the same key names
+and defaults for everything this framework supports, so an operator's
 cruisecontrol.properties carries over. Goal class names accept both the
 reference's Java class paths (mapped onto our goal registry by simple name) and
 native `cruise_control_tpu...` paths.
+
+Waived reference keys (present there, deliberately absent here): the eight
+Kafka-client plumbing keys the reference passes straight into its embedded
+NetworkClient/consumers — bootstrap.servers, client.id, connections.max.idle.ms,
+metadata.max.age.ms, receive.buffer.bytes, send.buffer.bytes,
+reconnect.backoff.ms, request.timeout.ms (KafkaCruiseControlConfig.java:724-806).
+The TPU build has no in-process Kafka client: cluster I/O rides the agent wire
+protocol (executor/tcp_driver.py, docs/CLUSTER_AGENT.md), whose transport knobs
+live on the agent command line / driver constructor instead. Every other
+reference key exists here under the identical name.
 """
 
 from __future__ import annotations
@@ -251,6 +261,49 @@ def _config_def() -> ConfigDef:
              "Completed monitor-type user tasks retained (per-type retention).")
     d.define("max.cached.completed.kafka.admin.user.tasks", Type.INT, 25, at_least(0), Importance.LOW,
              "Completed admin-type user tasks retained (per-type retention).")
+    # per-type caches/retention for CC-endpoint tasks; negative = fall back
+    # to the generic key (the reference defaults these to null with the same
+    # fallback, KafkaCruiseControlConfig.java:967-1022)
+    d.define("max.cached.completed.cruise.control.monitor.user.tasks", Type.INT, -1, None,
+             Importance.LOW, "Completed CC-monitor-type user tasks retained; "
+             "negative = max.cached.completed.user.tasks.")
+    d.define("max.cached.completed.cruise.control.admin.user.tasks", Type.INT, -1, None,
+             Importance.LOW, "Completed CC-admin-type user tasks retained; "
+             "negative = max.cached.completed.user.tasks.")
+    d.define("completed.cruise.control.monitor.user.task.retention.time.ms", Type.LONG, -1, None,
+             Importance.LOW, "Retention of completed CC-monitor-type user tasks; "
+             "negative = completed.user.task.retention.time.ms.")
+    d.define("completed.cruise.control.admin.user.task.retention.time.ms", Type.LONG, -1, None,
+             Importance.LOW, "Retention of completed CC-admin-type user tasks; "
+             "negative = completed.user.task.retention.time.ms.")
+    d.define("completed.kafka.monitor.user.task.retention.time.ms", Type.LONG, -1, None,
+             Importance.LOW, "Retention of completed kafka-monitor-type user tasks; "
+             "negative = completed.user.task.retention.time.ms.")
+    d.define("completed.kafka.admin.user.task.retention.time.ms", Type.LONG, -1, None,
+             Importance.LOW, "Retention of completed kafka-admin-type user tasks; "
+             "negative = completed.user.task.retention.time.ms.")
+    d.define("partition.metric.sample.aggregator.completeness.cache.size", Type.INT, 5,
+             at_least(0), Importance.LOW,
+             "Cached completeness computations in the partition aggregator "
+             "(KafkaCruiseControlConfig.java:940; the TPU aggregator memoizes "
+             "completeness per (generation, options) up to this many entries).")
+    d.define("broker.metric.sample.aggregator.completeness.cache.size", Type.INT, 5,
+             at_least(0), Importance.LOW,
+             "Cached completeness computations in the broker aggregator "
+             "(KafkaCruiseControlConfig.java:1049).")
+    d.define("linear.regression.model.min.num.cpu.util.buckets", Type.INT, 5, at_least(1),
+             Importance.LOW,
+             "Minimum full CPU-utilization buckets required before the linear "
+             "regression model is considered trained (KafkaCruiseControlConfig.java:1121).")
+    d.define("linear.regression.model.required.samples.per.bucket", Type.INT, 100, at_least(1),
+             Importance.LOW,
+             "Training samples required per CPU-utilization bucket "
+             "(KafkaCruiseControlConfig.java:1126).")
+    # static web-UI serving (KafkaCruiseControlMain.java:75-111)
+    d.define("webserver.ui.diskpath", Type.STRING, "", None, Importance.LOW,
+             "Directory of static web-UI files to serve; empty = disabled.")
+    d.define("webserver.ui.urlprefix", Type.STRING, "/*", None, Importance.LOW,
+             "URL prefix the static web-UI is served under.")
     d.define("webserver.http.cors.origin", Type.STRING, "*", None, Importance.LOW,
              "CORS Access-Control-Allow-Origin value.")
     d.define("webserver.http.cors.allowmethods", Type.STRING, "OPTIONS, GET, POST", None, Importance.LOW,

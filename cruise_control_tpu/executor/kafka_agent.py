@@ -1,0 +1,342 @@
+"""Production cluster agent: the wire protocol served against a REAL Kafka.
+
+The executor's live-cluster binding ends at the JSON-lines agent protocol
+(executor/tcp_driver.py module docstring: reassign / leader / finished /
+ongoing / ping, plus the metrics transport's metrics_publish / metrics_poll).
+`testing.fake_agent.FakeClusterAgent` implements that protocol against the
+in-process simulator for tests; THIS module is the reference production
+implementation, mapping the same ops onto a Kafka admin client — the analog
+of the reference's ZK bridge and Kafka-backed sample store:
+
+  reassign   -> AdminClient.alter_partition_reassignments, the KIP-455
+                successor of writing reassignment JSON into ZooKeeper
+                (scala/executor/ExecutorUtils.scala:32)
+  leader     -> preferred-leader election
+                (scala PreferredReplicaLeaderElectionCommand wrapper)
+  finished   -> list_partition_reassignments: a topic-partition absent from
+                the in-flight set has completed (the reference polls the
+                reassignment znode until it clears, cc/executor/Executor.java)
+  ongoing    -> list_partition_reassignments non-empty
+                (cc/executor/Executor.java:494 refuses to start over one)
+  metrics_*  -> produce/consume on a metrics topic, the deployment shape of
+                CruiseControlMetricsReporter + KafkaSampleStore
+                (mr/CruiseControlMetricsReporter.java:128,
+                cc/monitor/sampling/KafkaSampleStore.java:294)
+
+Layering: `ClusterAgentServer` owns the protocol bookkeeping (executionId
+tracking, sticky-until-consumed completion, unknown-id tolerance) against an
+`AdminAdapter` SPI; `KafkaAdminAdapter` is the kafka-python binding. The
+split keeps the protocol logic unit-testable without a broker (the sandbox
+has none), while the adapter stays a thin, auditable mapping. kafka-python
+is imported lazily and guarded — constructing `KafkaAdminAdapter` without it
+raises a clear error, and nothing in this module runs at package import.
+
+Run standalone:
+  python -m cruise_control_tpu.executor.kafka_agent \
+      --bootstrap localhost:9092 --port 9500 [--metrics-topic __CCMetrics]
+"""
+
+from __future__ import annotations
+
+import json
+import socketserver
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class AdminAdapter:
+    """What the agent needs from a cluster admin client.
+
+    Implementations must be thread-safe (the agent server handles each
+    connection on its own thread)."""
+
+    def begin_reassignment(self, topic: str, partition: int, replicas: List[int]) -> None:
+        """Start moving the partition to `replicas` (async)."""
+        raise NotImplementedError
+
+    def elect_leader(self, topic: str, partition: int, leader: int) -> None:
+        """Make `leader` the partition's leader (preferred election)."""
+        raise NotImplementedError
+
+    def reassignment_done(self, topic: str, partition: int) -> bool:
+        """True when no reassignment is in flight for the partition."""
+        raise NotImplementedError
+
+    def any_ongoing(self) -> bool:
+        """True when ANY reassignment is in flight cluster-wide."""
+        raise NotImplementedError
+
+    def publish_metrics(self, records: List[str]) -> None:
+        """Durably accept reporter records (hex-encoded serde payloads)."""
+        raise NotImplementedError
+
+    def poll_metrics(self, max_records: int) -> List[str]:
+        """Return up to max_records pending records, consuming them."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class KafkaAdminAdapter(AdminAdapter):
+    """kafka-python binding of the AdminAdapter SPI.
+
+    Requires kafka-python >= 2.0 (KIP-455 reassignment APIs). The import is
+    deferred to construction so the module stays importable in environments
+    without a Kafka client (this sandbox); integration tests run against the
+    protocol-level fake instead (tests/test_cluster_binding.py).
+    """
+
+    def __init__(self, bootstrap_servers: str, metrics_topic: str = "__CruiseControlMetrics",
+                 client_id: str = "cruise-control-tpu-agent"):
+        try:
+            from kafka import KafkaConsumer, KafkaProducer
+            from kafka.admin import KafkaAdminClient
+        except ImportError as e:  # pragma: no cover - no broker in CI
+            raise RuntimeError(
+                "KafkaAdminAdapter requires kafka-python (pip install kafka-python); "
+                "use testing.fake_agent.FakeClusterAgent for tests"
+            ) from e
+        self._admin = KafkaAdminClient(
+            bootstrap_servers=bootstrap_servers, client_id=client_id
+        )
+        self._producer = KafkaProducer(bootstrap_servers=bootstrap_servers)
+        self._consumer = KafkaConsumer(
+            metrics_topic,
+            bootstrap_servers=bootstrap_servers,
+            group_id=client_id,
+            enable_auto_commit=True,
+            consumer_timeout_ms=500,
+        )
+        self._metrics_topic = metrics_topic
+        self._lock = threading.Lock()
+
+    def begin_reassignment(self, topic: str, partition: int, replicas: List[int]) -> None:
+        # KIP-455 AlterPartitionReassignments — the post-ZK form of
+        # ExecutorUtils.executeReplicaReassignmentTasks (scala :32). Newer
+        # kafka-python exposes it as alter_partition_reassignments; guard so
+        # an older client fails loudly rather than silently no-oping.
+        alter = getattr(self._admin, "alter_partition_reassignments", None)
+        if alter is None:  # pragma: no cover - version-dependent
+            raise RuntimeError(
+                "kafka-python too old: alter_partition_reassignments missing "
+                "(need the KIP-455 admin API)"
+            )
+        with self._lock:
+            alter({(topic, partition): replicas})
+
+    def elect_leader(self, topic: str, partition: int, leader: int) -> None:
+        # Preferred-leader election: KIP-460 ElectLeaders
+        # (PreferredReplicaLeaderElectionCommand semantics). Requires a
+        # client that exposes it — re-ordering the replica list via a
+        # reassignment does NOT elect by itself (the leader only changes on
+        # an unrelated auto.leader.rebalance cycle), so faking it here would
+        # let the agent report leadership movements complete that never
+        # happened. Fail loudly instead.
+        elect = getattr(self._admin, "perform_leader_election", None)
+        if elect is None:  # pragma: no cover - version-dependent
+            raise RuntimeError(
+                "kafka-python does not expose perform_leader_election "
+                "(KIP-460); upgrade the client — leadership movements "
+                "cannot be executed correctly without it"
+            )
+        with self._lock:
+            elect("PREFERRED", [(topic, partition)])
+
+    def _in_flight(self) -> Dict[Tuple[str, int], List[int]]:
+        lister = getattr(self._admin, "list_partition_reassignments", None)
+        if lister is None:  # pragma: no cover - version-dependent
+            raise RuntimeError(
+                "kafka-python too old: list_partition_reassignments missing"
+            )
+        with self._lock:
+            return dict(lister() or {})
+
+    def reassignment_done(self, topic: str, partition: int) -> bool:
+        return (topic, partition) not in self._in_flight()
+
+    def any_ongoing(self) -> bool:
+        return bool(self._in_flight())
+
+    def publish_metrics(self, records: List[str]) -> None:
+        for rec in records:
+            self._producer.send(self._metrics_topic, bytes.fromhex(rec))
+        self._producer.flush()
+
+    def poll_metrics(self, max_records: int) -> List[str]:
+        out: List[str] = []
+        for msg in self._consumer:
+            out.append(bytes(msg.value).hex())
+            if len(out) >= max_records:
+                break
+        return out
+
+    def close(self) -> None:
+        for c in (self._consumer, self._producer, self._admin):
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
+class ClusterAgentServer:
+    """JSON-lines TCP server speaking the cluster-agent protocol against any
+    AdminAdapter.
+
+    Protocol bookkeeping matches the contract in executor/tcp_driver.py:
+    completion is sticky until consumed once via "finished"; executionIds the
+    agent never saw (a restarted driver) report unfinished; `leader` ops
+    complete on their next "finished" probe (elections are synchronous at the
+    admin API). `ssl_context` wraps accepted connections in TLS (the
+    metrics-path security story; see reporter/transport.py).
+    """
+
+    #: completed executionIds remembered for late probes; bounded — the
+    #: driver consumes completion exactly once (tcp_driver.is_finished), so
+    #: old entries only serve duplicate probes and a production agent that
+    #: rebalances continuously must not leak one entry per movement forever
+    FINISHED_CAP = 65536
+
+    def __init__(self, adapter: AdminAdapter, host: str = "127.0.0.1",
+                 port: int = 0, ssl_context=None):
+        import collections
+
+        self._adapter = adapter
+        self._lock = threading.Lock()
+        #: executionId -> (topic, partition) still moving; None = leader op
+        self._pending: Dict[int, Optional[Tuple[str, int]]] = {}
+        self._finished: "collections.OrderedDict" = collections.OrderedDict()
+        agent = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def setup(self):
+                if ssl_context is not None:
+                    self.request = ssl_context.wrap_socket(
+                        self.request, server_side=True
+                    )
+                super().setup()
+
+            def handle(self):
+                while True:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    try:
+                        req = json.loads(line)
+                        resp = agent._dispatch(req)
+                    except Exception as e:
+                        resp = {"ok": False, "error": repr(e)}
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._server.server_address
+
+    def start(self) -> "ClusterAgentServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="cluster-agent", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._adapter.close()
+
+    def _dispatch(self, req: Dict) -> Dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True}
+        if op == "reassign":
+            topic, part = str(req["topic"]), int(req["partition"])
+            self._adapter.begin_reassignment(
+                topic, part, [int(b) for b in req["replicas"]]
+            )
+            with self._lock:
+                self._pending[int(req["executionId"])] = (topic, part)
+            return {"ok": True}
+        if op == "leader":
+            self._adapter.elect_leader(
+                str(req["topic"]), int(req["partition"]), int(req["leader"])
+            )
+            with self._lock:
+                # elections are synchronous at the admin API: done on the
+                # next probe
+                self._pending[int(req["executionId"])] = None
+            return {"ok": True}
+        if op == "finished":
+            done = []
+            with self._lock:
+                pending = dict(self._pending)
+                finished = set(self._finished)
+            for eid in req.get("executionIds", ()):
+                eid = int(eid)
+                if eid in finished:
+                    done.append(eid)
+                    continue
+                if eid not in pending:
+                    continue  # unknown id (restarted driver): unfinished
+                tp = pending[eid]
+                if tp is None or self._adapter.reassignment_done(*tp):
+                    done.append(eid)
+            with self._lock:
+                for eid in done:
+                    self._pending.pop(eid, None)
+                    self._finished[eid] = True
+                    self._finished.move_to_end(eid)
+                while len(self._finished) > self.FINISHED_CAP:
+                    self._finished.popitem(last=False)
+            return {"ok": True, "finished": done}
+        if op == "ongoing":
+            return {"ok": True, "ongoing": self._adapter.any_ongoing()}
+        if op == "metrics_publish":
+            self._adapter.publish_metrics(list(req.get("records", ())))
+            return {"ok": True}
+        if op == "metrics_poll":
+            records = self._adapter.poll_metrics(int(req.get("max", 10000)))
+            return {"ok": True, "records": records}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+
+def main(argv: Optional[List[str]] = None) -> None:  # pragma: no cover - needs a broker
+    import argparse
+
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--bootstrap", required=True, help="Kafka bootstrap servers")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=9500)
+    parser.add_argument("--metrics-topic", default="__CruiseControlMetrics")
+    parser.add_argument("--tls-cert", help="PEM cert; enables TLS with --tls-key")
+    parser.add_argument("--tls-key", help="PEM private key")
+    args = parser.parse_args(argv)
+    ssl_context = None
+    if args.tls_cert:
+        import ssl
+
+        ssl_context = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ssl_context.load_cert_chain(args.tls_cert, args.tls_key)
+    adapter = KafkaAdminAdapter(args.bootstrap, metrics_topic=args.metrics_topic)
+    server = ClusterAgentServer(
+        adapter, host=args.host, port=args.port, ssl_context=ssl_context
+    )
+    server.start()
+    print(f"cluster agent serving on {server.address}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -59,11 +59,20 @@ class AgentProtocolError(RuntimeError):
 
 
 class _LineClient:
-    """Blocking JSON-lines client over one persistent socket."""
+    """Blocking JSON-lines client over one persistent socket.
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    `ssl_context` wraps the connection in TLS (the SslTest analog for the
+    agent path, mr/CruiseControlMetricsReporter.java:110-128 configures
+    producer SSL); `server_hostname` is what the certificate is verified
+    against when the context checks hostnames (cert pinning: build the
+    context with load_verify_locations on the agent's own cert)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 ssl_context=None, server_hostname: Optional[str] = None):
         self._addr = (host, port)
         self._timeout = timeout_s
+        self._ssl_context = ssl_context
+        self._server_hostname = server_hostname or host
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
@@ -71,6 +80,10 @@ class _LineClient:
     def _connect(self) -> None:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
         sock.settimeout(self._timeout)
+        if self._ssl_context is not None:
+            sock = self._ssl_context.wrap_socket(
+                sock, server_hostname=self._server_hostname
+            )
         self._sock = sock
         self._rfile = sock.makefile("rb")
 
@@ -112,8 +125,10 @@ class _LineClient:
 class TcpClusterDriver(ClusterDriver):
     """Executor binding over the cluster-agent wire protocol above."""
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
-        self._client = _LineClient(host, port, timeout_s)
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 ssl_context=None, server_hostname: Optional[str] = None):
+        self._client = _LineClient(host, port, timeout_s, ssl_context=ssl_context,
+                                   server_hostname=server_hostname)
         self._finished: Set[int] = set()
         self._in_flight: Dict[int, ExecutionTask] = {}
         self._lock = threading.Lock()

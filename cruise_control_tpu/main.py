@@ -26,6 +26,8 @@ def build_simulated_service(
     seed: int = 42,
     window_s: float = 5.0,
     two_step_verification: bool = False,
+    webui_dir: str = None,
+    webui_prefix: str = "/",
 ):
     """Wire the full stack over a simulated cluster; returns (app, parts)."""
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
@@ -79,7 +81,8 @@ def build_simulated_service(
     acc = AsyncCruiseControl(facade)
     detector = AnomalyDetector(facade, notifier=SelfHealingNotifier())
     app = CruiseControlApp(
-        acc, anomaly_detector=detector, two_step_verification=two_step_verification
+        acc, anomaly_detector=detector, two_step_verification=two_step_verification,
+        webui_dir=webui_dir, webui_prefix=webui_prefix,
     )
     parts = {
         "sim": sim, "reporters": reporters, "monitor": monitor, "runner": runner,
@@ -113,6 +116,11 @@ def main(argv=None) -> int:
     parser.add_argument("--operation-log", default=None, metavar="PATH",
                         help="append the operation audit trail (executions, anomaly "
                              "decisions, self-healing fixes) to PATH")
+    parser.add_argument("--webui-dir", default=None, metavar="DIR",
+                        help="serve static web-UI files from DIR "
+                             "(webserver.ui.diskpath, KafkaCruiseControlMain.java:75)")
+    parser.add_argument("--webui-prefix", default="/", metavar="PREFIX",
+                        help="URL prefix for the static web-UI (webserver.ui.urlprefix)")
     args = parser.parse_args(argv)
 
     # probe the default backend before anything touches JAX: a dead TPU
@@ -130,6 +138,7 @@ def main(argv=None) -> int:
     app, parts = build_simulated_service(
         num_brokers=args.simulate_brokers, num_topics=args.simulate_topics,
         seed=args.seed, two_step_verification=args.two_step_verification,
+        webui_dir=args.webui_dir, webui_prefix=args.webui_prefix,
     )
     if args.operation_log:
         import logging

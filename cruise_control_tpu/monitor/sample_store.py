@@ -111,18 +111,33 @@ class FileSampleStore(SampleStore):
             self._segment_ms = self._derive_segment_ms()
 
     def _segment_path(self, kind: str, time_ms: int) -> str:
+        # the width is PERSISTED in the name: expiry must judge a segment by
+        # the width it was WRITTEN with, not the current one — reopening a
+        # directory after the retention hint (and hence the derived width)
+        # shrinks would otherwise treat a wide old segment as expired while
+        # it still holds in-retention samples
         start = (time_ms // self._segment_ms) * self._segment_ms
-        return os.path.join(self._dir, f"{kind}-{start}.bin")
+        return os.path.join(self._dir, f"{kind}-{start}w{self._segment_ms}.bin")
 
-    def _segments(self, kind: str) -> List[Tuple[int, str]]:
-        """[(segment_start_ms, path)] for this kind, oldest first."""
+    def _segments(self, kind: str) -> List[Tuple[int, int, str]]:
+        """[(segment_start_ms, width_ms, path)] for this kind, oldest first.
+
+        Width-less names come from processes predating width persistence;
+        their span is bounded conservatively by max(default, current width)
+        (the derivation never exceeded the default unless explicitly
+        constructed wider), which can only over-retain one segment."""
         out = []
         prefix = f"{kind}-"
+        fallback = max(self.SEGMENT_DEFAULT_MS, self._segment_ms)
         for name in os.listdir(self._dir):
             if name.startswith(prefix) and name.endswith(".bin"):
                 stem = name[len(prefix):-4]
                 if stem.isdigit():
-                    out.append((int(stem), os.path.join(self._dir, name)))
+                    out.append((int(stem), fallback, os.path.join(self._dir, name)))
+                elif "w" in stem:
+                    start, _, width = stem.partition("w")
+                    if start.isdigit() and width.isdigit():
+                        out.append((int(start), int(width), os.path.join(self._dir, name)))
         return sorted(out)
 
     def _append(self, kind: str, samples) -> None:
@@ -146,8 +161,8 @@ class FileSampleStore(SampleStore):
         cutoff = self._cutoff_ms()
         if cutoff is None:
             return
-        for start, path in self._segments(kind):
-            if start + self._segment_ms <= cutoff:
+        for start, width, path in self._segments(kind):
+            if start + width <= cutoff:
                 try:
                     os.unlink(path)
                 except OSError:
@@ -189,14 +204,14 @@ class FileSampleStore(SampleStore):
             # up to one segment and delete still-in-retention history at
             # restart; an underestimate only ever keeps one extra segment.
             newest = max(
-                [s.time_ms for s in out] + [start for start, _ in segments]
+                [s.time_ms for s in out] + [start for start, _, _ in segments]
                 or [0]
             )
             if newest > self._max_time_ms:
                 self._max_time_ms = newest
         cutoff = self._cutoff_ms()
-        for start, path in segments:
-            if cutoff is not None and start + self._segment_ms <= cutoff:
+        for start, width, path in segments:
+            if cutoff is not None and start + width <= cutoff:
                 try:
                     os.unlink(path)  # truncate on load: bounded restart replay
                 except OSError:

@@ -127,10 +127,12 @@ class TcpMetricsTransport(MetricsTransport):
           -> {"ok": true, "records": [hex, ...]}   (at-most-once consume)
     """
 
-    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0,
+                 ssl_context=None, server_hostname: Optional[str] = None):
         from cruise_control_tpu.executor.tcp_driver import _LineClient
 
-        self._client = _LineClient(host, port, timeout_s)
+        self._client = _LineClient(host, port, timeout_s, ssl_context=ssl_context,
+                                   server_hostname=server_hostname)
 
     def publish(self, metrics: List[CruiseControlMetric]) -> None:
         # NOT retried on a mid-exchange drop: a re-send could double-count

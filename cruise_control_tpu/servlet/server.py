@@ -105,7 +105,12 @@ class CruiseControlApp:
         anomaly_detector=None,
         two_step_verification: bool = False,
         response_wait_s: float = 1.0,
+        webui_dir: Optional[str] = None,
+        webui_prefix: str = "/",
     ):
+        """`webui_dir`: directory of static web-UI files served under
+        `webui_prefix` (webserver.ui.diskpath / webserver.ui.urlprefix — the
+        optional Jetty web-UI dir, KafkaCruiseControlMain.java:75-111)."""
         self._acc = async_cc
         self._facade = async_cc.facade
         self._detector = anomaly_detector
@@ -113,6 +118,8 @@ class CruiseControlApp:
         self._purgatory = Purgatory() if two_step_verification else None
         self._two_step = two_step_verification
         self._wait_s = response_wait_s
+        self._webui_dir = webui_dir
+        self._webui_prefix = "/" + (webui_prefix or "/").strip("/*").strip("/")
 
     # -- helpers ---------------------------------------------------------------
 
@@ -535,6 +542,46 @@ class CruiseControlApp:
             app.router.add_get(f"{PREFIX}/{name}", handler)
         for name, handler in p:
             app.router.add_post(f"{PREFIX}/{name}", handler)
+        if self._webui_dir:
+            import os
+
+            if os.path.isdir(self._webui_dir):
+                prefix = self._webui_prefix or "/"
+                if prefix != "/":
+                    app.router.add_static(prefix, self._webui_dir,
+                                          show_index=False)
+                else:
+                    # aiohttp's static route cannot own "/" next to the API
+                    # prefix; serve index.html + files explicitly
+                    webui_dir = self._webui_dir
+
+                    async def index(_request):
+                        path = os.path.join(webui_dir, "index.html")
+                        if not os.path.isfile(path):
+                            raise web.HTTPNotFound()
+                        return web.FileResponse(path)
+
+                    base = os.path.abspath(webui_dir)
+
+                    async def static_file(request):
+                        rel = request.match_info["tail"]
+                        path = os.path.abspath(os.path.join(base, rel))
+                        if not path.startswith(base + os.sep):
+                            raise web.HTTPForbidden()  # traversal guard
+                        if not os.path.isfile(path):
+                            raise web.HTTPNotFound()
+                        return web.FileResponse(path)
+
+                    app.router.add_get("/", index)
+                    app.router.add_get("/{tail:(?!kafkacruisecontrol).+}",
+                                       static_file)
+            else:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "webserver.ui.diskpath %r is not a directory; web-UI "
+                    "serving disabled", self._webui_dir,
+                )
         return app
 
 

@@ -24,7 +24,8 @@ from typing import Dict, Optional, Tuple
 class FakeClusterAgent:
     """JSON-lines TCP server applying reassignments to a SimulatedCluster."""
 
-    def __init__(self, sim, latency_polls: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, sim, latency_polls: int = 0, host: str = "127.0.0.1",
+                 ssl_context=None):
         self._sim = sim
         self._latency = latency_polls
         self._lock = threading.Lock()
@@ -35,6 +36,15 @@ class FakeClusterAgent:
         agent = self
 
         class Handler(socketserver.StreamRequestHandler):
+            def setup(self):
+                # TLS termination on the agent socket (the SslTest analog:
+                # the reference integration-tests its reporter under SSL)
+                if ssl_context is not None:
+                    self.request = ssl_context.wrap_socket(
+                        self.request, server_side=True
+                    )
+                super().setup()
+
             def handle(self):
                 while True:
                     line = self.rfile.readline()

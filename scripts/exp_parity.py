@@ -1,0 +1,76 @@
+"""Focused parity experiment: batched vs greedy on the config-5 family at a
+chosen scale, printing the per-goal cost table (scripts/ = dev tooling, not
+shipped API). Usage:
+  JAX_PLATFORMS=cpu python scripts/exp_parity.py [brokers] [goal-subset]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+brokers = int(sys.argv[1]) if len(sys.argv) > 1 else 130
+subset = sys.argv[2] if len(sys.argv) > 2 else None
+
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+
+prop = ClusterProperty(
+    num_racks=52, num_brokers=brokers, num_topics=max(50, (brokers * 20) // 13),
+    mean_partitions_per_topic=50.0, replication_factor=3,
+    load_distribution="exponential",
+)
+model = random_cluster(42 + 5, prop)
+print(f"model: {model.num_brokers}B / {model.num_partitions}P", flush=True)
+
+goal_names = None
+if subset:
+    goal_names = subset.split(",")
+
+chunk = int(os.environ.get("BENCH_CHUNK_ROUNDS", "16"))
+batched_s = OptimizerSettings(batch_k=1024, max_rounds_per_goal=128,
+                              num_dst_candidates=16, num_swap_pairs=16,
+                              swap_candidates=16, swaps_per_broker=4,
+                              chunk_rounds=chunk)
+ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "8192"))
+greedy_s = OptimizerSettings(batch_k=1, max_rounds_per_goal=512,
+                             num_dst_candidates=16, num_swap_pairs=16,
+                             swap_candidates=16, swaps_per_broker=4,
+                             chunk_rounds=chunk * 4,
+                             cost_scaled_rounds=1.5, rounds_ceiling=ceiling)
+
+
+def run(tag, settings):
+    opt = GoalOptimizer(settings=settings)
+    t0 = time.monotonic()
+    opt.warmup(model, goal_names=goal_names)
+    print(f"{tag} compile: {time.monotonic() - t0:.1f}s", flush=True)
+    t0 = time.monotonic()
+    res = opt.optimizations(model, goal_names=goal_names, raise_on_hard_failure=False)
+    wall = time.monotonic() - t0
+    print(f"{tag} wall: {wall:.2f}s moves={res.num_replica_moves} "
+          f"lead={res.num_leadership_moves}", flush=True)
+    for g in res.goal_results:
+        cap = "" if g.converged else "  CAP-BOUND"
+        print(f"  {tag} {g.name:38s} viol {g.violated_brokers_before:4d}->"
+              f"{g.violated_brokers_after:4d} cost {g.cost_before:12.1f}->"
+              f"{g.cost_after:10.1f} rounds {g.rounds:4d}{cap}", flush=True)
+    return wall, res
+
+
+b_wall, b_res = run("batched", batched_s)
+g_wall, g_res = run("greedy ", greedy_s)
+
+print("\nper-goal cost-after delta (batched - greedy; negative = batched better):")
+for bg, gg in zip(b_res.goal_results, g_res.goal_results):
+    delta = bg.cost_after - gg.cost_after
+    flag = ""
+    if delta > 0.05 * max(abs(gg.cost_after), 1e-9) and delta > 0.005 * max(gg.cost_before, 1.0):
+        flag = "  <-- REGRESSED"
+    print(f"  {bg.name:38s} {delta:+12.1f}  (viol {bg.violated_brokers_after} vs "
+          f"{gg.violated_brokers_after}){flag}")
+print(f"\nwalls: batched {b_wall:.2f}s greedy {g_wall:.2f}s "
+      f"speedup {g_wall / max(b_wall, 1e-9):.2f}x")

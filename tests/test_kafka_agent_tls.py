@@ -1,0 +1,204 @@
+"""TLS on the agent socket + the production agent's protocol bookkeeping.
+
+The reference integration-tests its metrics reporter under SSL
+(cruise-control-metrics-reporter SslTest; producer SSL config at
+mr/CruiseControlMetricsReporter.java:110-128). The TPU build's cluster-facing
+sockets are the agent wire protocol, so the analog is: the fake agent
+terminates TLS, the driver/metrics clients connect with a cert-PINNED
+context (the agent's own self-signed cert as the only trust root), and a
+plaintext client is rejected.
+
+The production agent (executor/kafka_agent.py) splits protocol bookkeeping
+from the kafka-python admin binding; the bookkeeping half is proven here
+against a recording adapter — no broker exists in CI, which is exactly why
+the adapter seam exists.
+"""
+
+import ssl
+import subprocess
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.executor import Executor
+from cruise_control_tpu.executor.kafka_agent import AdminAdapter, ClusterAgentServer
+from cruise_control_tpu.executor.tcp_driver import TcpClusterDriver, _LineClient
+from cruise_control_tpu.models.generators import unbalanced
+from cruise_control_tpu.testing.fake_agent import FakeClusterAgent
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+
+def proposal(p, old, new, mb=0.0):
+    return ExecutionProposal(partition=p, old_replicas=old, new_replicas=new,
+                             data_to_move_mb=mb)
+
+
+@pytest.fixture(scope="module")
+def cert_pair(tmp_path_factory):
+    """Self-signed server cert + key (openssl; SAN covers 127.0.0.1)."""
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    subprocess.run(
+        [
+            "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+            "-keyout", key, "-out", cert, "-days", "1",
+            "-subj", "/CN=localhost",
+            "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost",
+        ],
+        check=True, capture_output=True,
+    )
+    return cert, key
+
+
+def server_ctx(cert_pair):
+    cert, key = cert_pair
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert, key)
+    return ctx
+
+
+def pinned_client_ctx(cert_pair):
+    """Trust EXACTLY the agent's own cert (pinning, not a public CA)."""
+    cert, _ = cert_pair
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(cert)
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.check_hostname = True
+    return ctx
+
+
+def test_executor_and_metrics_over_tls(cert_pair):
+    sim = SimulatedCluster(unbalanced())
+    agent = FakeClusterAgent(sim, latency_polls=1,
+                             ssl_context=server_ctx(cert_pair)).start()
+    try:
+        driver = TcpClusterDriver(*agent.address,
+                                  ssl_context=pinned_client_ctx(cert_pair))
+        result = Executor(driver).execute_proposals(
+            [proposal(0, (0, 1), (2, 1), mb=5.0)]
+        )
+        assert result["numFinishedMovements"] == 1
+        assert sim.has_partition(0, 2) and not sim.has_partition(0, 0)
+
+        from cruise_control_tpu.reporter.transport import TcpMetricsTransport
+
+        transport = TcpMetricsTransport(*agent.address,
+                                        ssl_context=pinned_client_ctx(cert_pair))
+        metrics = sim.all_metrics(1000)
+        transport.publish(metrics)
+        assert len(transport.poll()) == len(metrics)
+        transport.close()
+        driver.close()
+    finally:
+        agent.stop()
+
+
+def test_plaintext_client_rejected_by_tls_agent(cert_pair):
+    sim = SimulatedCluster(unbalanced())
+    agent = FakeClusterAgent(sim, ssl_context=server_ctx(cert_pair)).start()
+    try:
+        client = _LineClient(*agent.address, timeout_s=2.0)  # no TLS
+        with pytest.raises((OSError, ConnectionError)):
+            client.request({"op": "ping"})
+        client.close()
+    finally:
+        agent.stop()
+
+
+def test_untrusted_cert_rejected(cert_pair, tmp_path):
+    """A client pinned to a DIFFERENT cert must refuse the handshake."""
+    other_cert, other_key = str(tmp_path / "o.pem"), str(tmp_path / "o.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", other_key, "-out", other_cert, "-days", "1",
+         "-subj", "/CN=localhost",
+         "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+        check=True, capture_output=True,
+    )
+    sim = SimulatedCluster(unbalanced())
+    agent = FakeClusterAgent(sim, ssl_context=server_ctx(cert_pair)).start()
+    try:
+        ctx = pinned_client_ctx((other_cert, other_key))
+        client = _LineClient(*agent.address, timeout_s=2.0, ssl_context=ctx)
+        with pytest.raises((ssl.SSLError, OSError)):
+            client.request({"op": "ping"})
+        client.close()
+    finally:
+        agent.stop()
+
+
+# -- production agent protocol bookkeeping (no broker needed) -----------------
+
+
+class RecordingAdapter(AdminAdapter):
+    """In-memory AdminAdapter: reassignments complete after N done-probes."""
+
+    def __init__(self, latency: int = 1):
+        self.calls = []
+        self._latency = latency
+        self._probes = {}
+        self._records = []
+
+    def begin_reassignment(self, topic, partition, replicas):
+        self.calls.append(("reassign", topic, partition, tuple(replicas)))
+        self._probes[(topic, partition)] = self._latency
+
+    def elect_leader(self, topic, partition, leader):
+        self.calls.append(("leader", topic, partition, leader))
+
+    def reassignment_done(self, topic, partition):
+        left = self._probes.get((topic, partition), 0)
+        if left > 0:
+            self._probes[(topic, partition)] = left - 1
+            return False
+        self._probes.pop((topic, partition), None)
+        return True
+
+    def any_ongoing(self):
+        return any(v >= 0 for v in self._probes.values()) and bool(self._probes)
+
+    def publish_metrics(self, records):
+        self._records.extend(records)
+
+    def poll_metrics(self, max_records):
+        out, self._records = self._records[:max_records], self._records[max_records:]
+        return out
+
+
+@pytest.fixture()
+def agent_server():
+    adapter = RecordingAdapter(latency=1)
+    server = ClusterAgentServer(adapter).start()
+    client = _LineClient(*server.address)
+    yield adapter, server, client
+    client.close()
+    server.stop()
+
+
+def test_cluster_agent_server_protocol(agent_server):
+    adapter, server, client = agent_server
+    assert client.request({"op": "ping"})["ok"]
+    client.request({"op": "reassign", "executionId": 7, "topic": "t",
+                    "partition": 3, "replicas": [2, 1]})
+    assert adapter.calls == [("reassign", "t", 3, (2, 1))]
+    assert client.request({"op": "ongoing"})["ongoing"]
+    # first probe: adapter says still moving
+    assert client.request({"op": "finished", "executionIds": [7]})["finished"] == []
+    # second probe: done; sticky until consumed exactly once
+    assert client.request({"op": "finished", "executionIds": [7]})["finished"] == [7]
+    assert client.request({"op": "finished", "executionIds": [7]})["finished"] == [7]
+    # unknown ids (restarted driver) are never falsely finished
+    assert client.request({"op": "finished", "executionIds": [99]})["finished"] == []
+
+
+def test_cluster_agent_server_leader_and_metrics(agent_server):
+    adapter, server, client = agent_server
+    client.request({"op": "leader", "executionId": 11, "topic": "t",
+                    "partition": 0, "leader": 4})
+    assert adapter.calls == [("leader", "t", 0, 4)]
+    # elections are synchronous at the admin API: done on the next probe
+    assert client.request({"op": "finished", "executionIds": [11]})["finished"] == [11]
+    client.request({"op": "metrics_publish", "records": ["0a0b", "0c"]})
+    resp = client.request({"op": "metrics_poll", "max": 10})
+    assert resp["records"] == ["0a0b", "0c"]
+    assert client.request({"op": "metrics_poll", "max": 10})["records"] == []

@@ -484,6 +484,37 @@ def test_sample_store_retention_bounds_files_and_replay(tmp_path):
     assert len(part2) == len(part)
 
 
+def test_sample_store_segment_width_shrink_keeps_retained_history(tmp_path):
+    """Reopening a directory with a NARROWER segment width must not expire
+    wide old segments that still hold in-retention samples: expiry judges
+    each segment by the width it was written with (persisted in the file
+    name), not the current width."""
+    from cruise_control_tpu.monitor.metricdef import (
+        NUM_BROKER_METRICS,
+        NUM_COMMON_METRICS,
+    )
+    from cruise_control_tpu.monitor.samples import (
+        BrokerMetricSample,
+        PartitionMetricSample,
+    )
+
+    metrics = np.ones(NUM_COMMON_METRICS, dtype=np.float32)
+    bmetrics = np.ones(NUM_BROKER_METRICS, dtype=np.float32)
+    # wide segments: one 10s segment holds everything
+    wide = FileSampleStore(str(tmp_path), retention_ms=60_000, segment_ms=10_000)
+    for t in (1_000, 9_000):
+        wide.store_samples([PartitionMetricSample(1, t, metrics)],
+                           [BrokerMetricSample(0, t, bmetrics)])
+    # reopen with much narrower segments and a tight retention whose cutoff
+    # lands INSIDE the wide segment: cutoff = 9000 - 5000 = 4000. Judged at
+    # the new 1s width the wide segment (start 0) would look expired
+    # (0 + 1000 <= 4000) although it still holds the in-retention t=9000.
+    narrow = FileSampleStore(str(tmp_path), retention_ms=5_000, segment_ms=1_000)
+    part, brok = narrow.load_samples()
+    times = sorted(s.time_ms for s in part)
+    assert 9_000 in times, "in-retention sample deleted by width-blind expiry"
+
+
 # -- bootstrap / training tasks (LoadMonitorTaskRunner state machine) ----------
 
 

@@ -341,3 +341,48 @@ def test_two_step_verification_gate(server):
         loop.call_soon_threadsafe(loop.stop)
         th.join(timeout=5)
         acc.shutdown()
+
+
+def test_static_webui_serving(tmp_path):
+    """webserver.ui.diskpath analog: static files served next to the API
+    prefix, with index at "/" and traversal blocked
+    (KafkaCruiseControlMain.java:75-111)."""
+    import urllib.error
+    import urllib.request
+
+    (tmp_path / "index.html").write_text("<html>cc-ui</html>")
+    (tmp_path / "app.js").write_text("console.log('ui')")
+
+    class _Stub:
+        facade = None
+
+    app = CruiseControlApp(_Stub(), webui_dir=str(tmp_path), webui_prefix="/")
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        assert "cc-ui" in urllib.request.urlopen(f"{base}/").read().decode()
+        assert "console" in urllib.request.urlopen(f"{base}/app.js").read().decode()
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/../etc/passwd")
+        assert e.value.code in (403, 404)
+        with pytest.raises(urllib.error.HTTPError) as e2:
+            urllib.request.urlopen(f"{base}/missing.css")
+        assert e2.value.code == 404
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=5)

@@ -341,16 +341,19 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
             static, agg, tables, gs, pair_t, pair_b, rnd, c_dst, b_count
         )
 
+        # lazy broadcast shapes (see make_drain_round): gathers index
+        # [V, K, 1] partitions and [V, 1, C] destinations, never the dense
+        # [V, K, C] cube; comparisons broadcast
         full = (v, k, c_dst)
         acts = build_selected(
             static.part_load, agg.assignment,
-            jnp.broadcast_to(cand_p[:, :, None], full),
+            cand_p[:, :, None],
             jnp.int32(KIND_MOVE),
-            jnp.broadcast_to(cand_s[:, :, None], full),
-            jnp.broadcast_to(dst_list[:, None, :], full),
+            cand_s[:, :, None],
+            dst_list[:, None, :],
         )
         s = score_batch(static, agg, acts, goal, gs, tables)
-        s = jnp.where(cand_ok[:, :, None], s, -jnp.inf)
+        s = jnp.broadcast_to(jnp.where(cand_ok[:, :, None], s, -jnp.inf), full)
         # de-correlate near-tied destinations across rows: goal scores for a
         # surplus move are mostly the same value (one unit of excess fixed),
         # so a plain argmax sends every pair to the same lowest-index feasible
@@ -550,15 +553,18 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
         g_s2 = ret_s[dsts]
         g_ok = ret_ok[dsts] & cand_ok[:, None, None]
         full = g_p2.shape
+        # lazy broadcast (see make_drain_round): the out-leg indices stay
+        # [V, 1, 1] and destinations [V, D, 1]; only the return-leg arrays are
+        # genuinely [V, D, K]
         ok, improve, _, _ = validate(
             static, agg, tables, gs,
-            jnp.broadcast_to(p1[:, None, None], full),
-            jnp.broadcast_to(s1[:, None, None], full),
-            jnp.broadcast_to(pair_b[:, None, None], full),
+            p1[:, None, None],
+            s1[:, None, None],
+            pair_b[:, None, None],
             g_p2, g_s2,
-            jnp.broadcast_to(dsts[:, :, None], full),
+            dsts[:, :, None],
         )
-        score0 = jnp.where(ok & g_ok, improve, -jnp.inf)
+        score0 = jnp.broadcast_to(jnp.where(ok & g_ok, improve, -jnp.inf), full)
         cells = score0.reshape(v, d_dst * k_ret)
         rows0 = jnp.arange(v, dtype=jnp.int32)
         waves = max(1, apply_waves)
@@ -734,15 +740,18 @@ def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
         c2p, c2s, c2ok = light_picks(static, agg, ret_contrib, hot, k2, b_count)
 
         # grid [V, K1, R-1, K2]: first leg (p1 -> its s1-th follower broker),
-        # joined against return candidates whose leader IS that broker
+        # joined against return candidates whose leader IS that broker.
+        # Lazy broadcast shapes (see make_drain_round): each index array
+        # keeps only the axes it varies over, so gathers stay [V,K1,·]- or
+        # [V,·,K2]-sized; only g_d is genuinely joint ([V, K1, R-1, 1]).
         full = (v, k1, r_f, k2)
-        g_p1 = jnp.broadcast_to(c1p[:, :, None, None], full)
+        g_p1 = c1p[:, :, None, None]
         s1_all = jnp.arange(1, r, dtype=jnp.int32)
-        g_s1 = jnp.broadcast_to(s1_all[None, None, :, None], full)
-        g_b = jnp.broadcast_to(hot[:, None, None, None], full)
-        g_p2 = jnp.broadcast_to(c2p[:, None, None, :], full)
-        g_s2 = jnp.broadcast_to(c2s[:, None, None, :], full)
-        g_d = agg.assignment[g_p1, g_s1]  # first-leg destination
+        g_s1 = s1_all[None, None, :, None]
+        g_b = hot[:, None, None, None]
+        g_p2 = c2p[:, None, None, :]
+        g_s2 = c2s[:, None, None, :]
+        g_d = agg.assignment[g_p1, g_s1]  # first-leg destination [V,K1,R-1,1]
         g_ok = (
             c1ok[:, :, None, None]
             & c2ok[:, None, None, :]
@@ -753,7 +762,7 @@ def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
             static, agg, tables, gs, g_p1, g_s1, g_b, g_p2, g_s2,
             jnp.maximum(g_d, 0),
         )
-        score0 = jnp.where(ok & g_ok, improve, -jnp.inf)
+        score0 = jnp.broadcast_to(jnp.where(ok & g_ok, improve, -jnp.inf), full)
         n_cells = k1 * r_f * k2
         cells = score0.reshape(v, n_cells)
         rows0 = jnp.arange(v, dtype=jnp.int32)
@@ -866,20 +875,26 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
         cand_ok = cand_ok & hot_ok[:, None]
 
         cold = rack_diverse_cold(static, gs, agg, goal, tables, dims, c)
-        dsts = goal.dst_candidates(static, gs, agg, tables, cand_p, cand_s, cold)
-        # dsts: [C] (global list) or [V, K, C] (per-candidate)
-        dsts = jnp.broadcast_to(dsts, (v, k, c)).astype(jnp.int32)
-
+        dsts_g = goal.dst_candidates(static, gs, agg, tables, cand_p, cand_s, cold)
+        # dsts_g: [C] (global list) or [V, K, C] (per-candidate). Score the
+        # grid with LAZY broadcast shapes — p/slot stay [V, K, 1] and a
+        # global destination list stays [1, 1, C] — so every gather indexes
+        # the smallest axis set it depends on ([V,K,1,M] part loads, [C]-row
+        # broker tables) instead of a materialized [V, K, C] index cube; the
+        # comparisons broadcast on the VPU for free. Only the wave picker
+        # needs the dense index cube (its picks are [V]-shaped).
         full = (v, k, c)
+        dst_lazy = (dsts_g[None, None, :] if dsts_g.ndim == 1 else dsts_g).astype(jnp.int32)
+        dsts = jnp.broadcast_to(dst_lazy, full).astype(jnp.int32)
         mv = build_selected(
             static.part_load, agg.assignment,
-            jnp.broadcast_to(cand_p[:, :, None], full),
+            cand_p[:, :, None],
             jnp.int32(KIND_MOVE),
-            jnp.broadcast_to(cand_s[:, :, None], full),
-            dsts,
+            cand_s[:, :, None],
+            dst_lazy,
         )
         s_mv = score_batch(static, agg, mv, goal, gs, tables)
-        s_mv = jnp.where(cand_ok[:, :, None], s_mv, -jnp.inf)
+        s_mv = jnp.broadcast_to(jnp.where(cand_ok[:, :, None], s_mv, -jnp.inf), full)
 
         if use_leadership:
             # GLOBAL leadership shortlist: promoting a follower shifts the

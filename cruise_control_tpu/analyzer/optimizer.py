@@ -145,6 +145,20 @@ class OptimizerSettings:
     drain_src: int = 512
     drain_per_broker: int = 8
     drain_dst: int = 64
+    #: > 0: after the priority stack completes, re-traverse every goal once
+    #: more — up to this many rounds each — under the FULL merged acceptance
+    #: tables (all goals' bounds, not just the priority prefix). The first
+    #: pass is lexicographic, so an early goal can stall in a state a LATER
+    #: goal's moves would have unblocked (the round-4 parity residual:
+    #: LeaderReplicaDistributionGoal stalls at cost 6 after the topic goal's
+    #: swaps consumed its slack); the polish pass retries those stalls once
+    #: the whole stack's moves have landed. Every polish action satisfies
+    #: EVERY goal's contributed bounds, so no goal's violated set can regress
+    #: (costs may drift within bounds; optimizations() re-measures final
+    #: per-goal stats when polish ran). The reference has no second pass
+    #: (GoalOptimizer.java:129-179 runs goals once) — this is TPU-side
+    #: headroom, and the parity gate only requires not being worse. 0 = off.
+    polish_rounds: int = 0
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -591,6 +605,24 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             rs.append(rounds)
             cv.append(empties >= loop.empties_to_stall)
             tables = goal.contribute_acceptance(static, gs1, tables)
+        if settings.polish_rounds > 0:
+            # polish pass under the FULL merged tables (see
+            # OptimizerSettings.polish_rounds); this traces every goal loop a
+            # second time, so the fused program roughly doubles — production
+            # uses the chunked machine, where the polish phases reuse the
+            # same traced branches
+            for i, (goal, loop) in enumerate(zip(goals, loops)):
+                agg, rounds, empties = loop(
+                    static, agg, tables, jnp.int32(settings.polish_rounds)
+                )
+                rs[i] = rs[i] + rounds
+                cv[i] = empties >= loop.empties_to_stall
+            for i, goal in enumerate(goals):
+                gs1 = goal.prepare(static, agg, dims)
+                va[i] = jnp.sum(
+                    goal.broker_violation(static, gs1, agg)
+                ).astype(jnp.int32)
+                ca[i] = goal.cost(static, gs1, agg).astype(jnp.float32)
         metrics = StackMetrics(
             violated_before=jnp.stack(vb),
             violated_after=jnp.stack(va),
@@ -656,26 +688,37 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     n_goals = len(goals)
     cap = settings.max_rounds_per_goal
 
+    # polish pass (settings.polish_rounds > 0): the phase cursor runs to
+    # 2*n_goals — phase n_goals + g re-runs goal g under the FULL merged
+    # tables (every goal contributed by then), so an early goal stalled by
+    # the lexicographic order retries once the whole stack's moves landed.
+    # The SAME G traced branches serve both passes (a traced `polishing`
+    # flag switches cap/metrics/table behavior), so the compiled program
+    # does not grow.
+    n_phases = 2 * n_goals if settings.polish_rounds > 0 else n_goals
+
     def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx,
                 rounds_in_goal, empties_in_goal, metrics: StackMetrics, budget):
         def make_branch(goal, loop):
             def branch(op):
                 agg_b, tables_b, gi, rig, emp, metrics_b, left = op
+                polishing = gi >= n_goals
+                gim = jnp.where(polishing, gi - n_goals, gi)
                 gs_in = goal.prepare(static, agg_b, dims)
                 viol_in = jnp.sum(
                     goal.broker_violation(static, gs_in, agg_b)
                 ).astype(jnp.int32)
                 cost_in = goal.cost(static, gs_in, agg_b).astype(jnp.float32)
-                first = rig == 0
+                first = (rig == 0) & ~polishing
                 metrics_b = metrics_b._replace(
                     violated_before=jnp.where(
                         first,
-                        metrics_b.violated_before.at[gi].set(viol_in),
+                        metrics_b.violated_before.at[gim].set(viol_in),
                         metrics_b.violated_before,
                     ),
                     cost_before=jnp.where(
                         first,
-                        metrics_b.cost_before.at[gi].set(cost_in),
+                        metrics_b.cost_before.at[gim].set(cost_in),
                         metrics_b.cost_before,
                     ),
                 )
@@ -687,12 +730,14 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                     # int cast — byte-denominated costs overflow int32
                     scaled = jnp.clip(
                         jnp.ceil(
-                            settings.cost_scaled_rounds * metrics_b.cost_before[gi]
+                            settings.cost_scaled_rounds * metrics_b.cost_before[gim]
                         ),
                         cap_g.astype(jnp.float32),
                         jnp.float32(settings.rounds_ceiling),
                     )
                     cap_g = scaled.astype(jnp.int32)
+                if settings.polish_rounds > 0:
+                    cap_g = jnp.where(polishing, jnp.int32(settings.polish_rounds), cap_g)
                 budget_g = jnp.minimum(left, cap_g - rig)
                 agg2, rounds, emp2 = loop(
                     static, agg_b, tables_b, budget_g,
@@ -708,13 +753,22 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 cost_out = goal.cost(static, gs_out, agg2).astype(jnp.float32)
                 tables_done = goal.contribute_acceptance(static, gs_out, tables_b)
                 tables2 = jax.tree.map(
-                    lambda a, b: jnp.where(done_goal, a, b), tables_done, tables_b
+                    lambda a, b: jnp.where(done_goal & ~polishing, a, b),
+                    tables_done, tables_b,
                 )
                 metrics_b = metrics_b._replace(
-                    violated_after=metrics_b.violated_after.at[gi].set(viol_out),
-                    cost_after=metrics_b.cost_after.at[gi].set(cost_out),
-                    rounds=metrics_b.rounds.at[gi].set(rig2),
-                    converged=metrics_b.converged.at[gi].set(stalled),
+                    violated_after=metrics_b.violated_after.at[gim].set(viol_out),
+                    cost_after=metrics_b.cost_after.at[gim].set(cost_out),
+                    # main pass: .set(rig2) is idempotent across chunk
+                    # re-entries (rig carries the running total); polish:
+                    # .add(this call's rounds) accumulates on top of the
+                    # main-pass total without clobbering it
+                    rounds=jnp.where(
+                        polishing,
+                        metrics_b.rounds.at[gim].add(rounds),
+                        metrics_b.rounds.at[gim].set(rig2),
+                    ),
+                    converged=metrics_b.converged.at[gim].set(stalled),
                 )
                 gi2 = jnp.where(done_goal, gi + 1, gi)
                 rig2 = jnp.where(done_goal, jnp.int32(0), rig2)
@@ -727,12 +781,13 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
 
         def cond(c):
             _, _, gi, _, _, _, left = c
-            return (left > 0) & (gi < n_goals)
+            return (left > 0) & (gi < n_phases)
 
         def body(c):
             agg_c, tables_c, gi, rig, emp, metrics_c, left = c
+            gim = jnp.where(gi >= n_goals, gi - n_goals, gi)
             return jax.lax.switch(
-                jnp.minimum(gi, n_goals - 1), branches,
+                jnp.minimum(gim, n_goals - 1), branches,
                 (agg_c, tables_c, gi, rig, emp, metrics_c, left),
             )
 
@@ -759,6 +814,31 @@ def empty_stack_metrics(n_goals: int) -> StackMetrics:
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
 def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
     return _make_goal_machine(goal_names, dims, settings)
+
+
+@functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
+def _cached_measure(goal_names: Tuple[str, ...], dims: Dims):
+    """jit (static, agg) -> (violated[G] i32, cost[G] f32) on the FINAL state.
+
+    Used after a polish pass: a later polish phase may drift an
+    earlier-polished goal's cost within its bounds, so per-phase exit
+    snapshots can be stale; the reported stats must describe the state the
+    cluster actually gets."""
+    from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
+
+    goals = [GOAL_REGISTRY[n] for n in goal_names]
+
+    def measure(static: StaticCtx, agg: Aggregates):
+        viol, cost = [], []
+        for goal in goals:
+            gs = goal.prepare(static, agg, dims)
+            viol.append(
+                jnp.sum(goal.broker_violation(static, gs, agg)).astype(jnp.int32)
+            )
+            cost.append(goal.cost(static, gs, agg).astype(jnp.float32))
+        return jnp.stack(viol), jnp.stack(cost)
+
+    return jax.jit(measure)
 
 
 #: AOT-compiled stack executables, keyed on (goal stack, dims, settings,
@@ -952,6 +1032,9 @@ class GoalOptimizer:
             goal_names, dims, self._settings, self._mesh, static, agg, tables
         )
         n = len(goal_names)
+        # polish pass (see _make_goal_machine): phases n..2n-1 re-run each
+        # goal under the full merged tables
+        n_phases = 2 * n if self._settings.polish_rounds > 0 else n
         gi = jnp.int32(0)
         rig = jnp.int32(0)
         emp = jnp.int32(0)
@@ -974,7 +1057,7 @@ class GoalOptimizer:
             if delta.sum() > 0:
                 durs += call_s * delta / delta.sum()
             rounds_seen = np.maximum(rounds_seen, rounds_h.astype(np.int64))
-            if int(gi_h) >= n:
+            if int(gi_h) >= n_phases:
                 break
             if int(gi_h) != last_gi:
                 # goal boundary crossed: per-round cost differs up to ~10x
@@ -991,6 +1074,9 @@ class GoalOptimizer:
                 # cannot balloon the budget right before an expensive goal.
                 rate = int(spent_h) / call_s
                 chunk = max(1, min(4096, int(rate * target_s), chunk * 8))
+        if self._settings.polish_rounds > 0:
+            viol, cost = _cached_measure(goal_names, dims)(static, agg)
+            metrics = metrics._replace(violated_after=viol, cost_after=cost)
         metrics = jax.device_get(metrics)
         return agg, metrics, time.monotonic() - t_stack, durs
 

@@ -284,13 +284,14 @@ def _config_def() -> ConfigDef:
              "negative = completed.user.task.retention.time.ms.")
     d.define("partition.metric.sample.aggregator.completeness.cache.size", Type.INT, 5,
              at_least(0), Importance.LOW,
-             "Cached completeness computations in the partition aggregator "
-             "(KafkaCruiseControlConfig.java:940; the TPU aggregator memoizes "
-             "completeness per (generation, options) up to this many entries).")
+             "Reference-parity key (KafkaCruiseControlConfig.java:940). The "
+             "TPU aggregator recomputes completeness per call — one dense "
+             "reduction over the ring buffers, cheaper than the reference's "
+             "object walk it caches — so this key is accepted but unused.")
     d.define("broker.metric.sample.aggregator.completeness.cache.size", Type.INT, 5,
              at_least(0), Importance.LOW,
-             "Cached completeness computations in the broker aggregator "
-             "(KafkaCruiseControlConfig.java:1049).")
+             "Reference-parity key (KafkaCruiseControlConfig.java:1049); "
+             "accepted but unused, as the partition twin above.")
     d.define("linear.regression.model.min.num.cpu.util.buckets", Type.INT, 5, at_least(1),
              Importance.LOW,
              "Minimum full CPU-utilization buckets required before the linear "

@@ -38,8 +38,6 @@ Run standalone:
 
 from __future__ import annotations
 
-import json
-import socketserver
 import threading
 from typing import Dict, List, Optional, Tuple
 
@@ -61,6 +59,14 @@ class AdminAdapter:
     def reassignment_done(self, topic: str, partition: int) -> bool:
         """True when no reassignment is in flight for the partition."""
         raise NotImplementedError
+
+    def pending_reassignments(self) -> Optional[set]:
+        """The set of (topic, partition) still moving, or None when the
+        client has no bulk listing — the agent then falls back to per-
+        partition reassignment_done probes. Implementations with a bulk API
+        should override: a 'finished' request probes every in-flight
+        executionId, and one listing answers all of them in one round-trip."""
+        return None
 
     def any_ongoing(self) -> bool:
         """True when ANY reassignment is in flight cluster-wide."""
@@ -90,13 +96,31 @@ class KafkaAdminAdapter(AdminAdapter):
     def __init__(self, bootstrap_servers: str, metrics_topic: str = "__CruiseControlMetrics",
                  client_id: str = "cruise-control-tpu-agent"):
         try:
-            from kafka import KafkaConsumer, KafkaProducer
+            from kafka import KafkaConsumer, KafkaProducer, TopicPartition
             from kafka.admin import KafkaAdminClient
         except ImportError as e:  # pragma: no cover - no broker in CI
             raise RuntimeError(
                 "KafkaAdminAdapter requires kafka-python (pip install kafka-python); "
                 "use testing.fake_agent.FakeClusterAgent for tests"
             ) from e
+        # the admin APIs take TYPED arguments (TopicPartition keys,
+        # NewPartitionReassignment values, an ElectionType member) — plain
+        # tuples/strings raise AttributeError inside the client's encoder.
+        # Resolved here, guarded, so an older client fails at construction
+        # with a clear message instead of mid-rebalance.
+        self._TopicPartition = TopicPartition
+        try:  # pragma: no cover - needs kafka-python
+            from kafka.admin import NewPartitionReassignment
+
+            self._NewPartitionReassignment = NewPartitionReassignment
+        except ImportError:
+            self._NewPartitionReassignment = None
+        try:  # pragma: no cover - needs kafka-python
+            from kafka.admin import ElectionType
+
+            self._preferred_election = ElectionType.PREFERRED
+        except ImportError:
+            self._preferred_election = None
         self._admin = KafkaAdminClient(
             bootstrap_servers=bootstrap_servers, client_id=client_id
         )
@@ -117,13 +141,16 @@ class KafkaAdminAdapter(AdminAdapter):
         # kafka-python exposes it as alter_partition_reassignments; guard so
         # an older client fails loudly rather than silently no-oping.
         alter = getattr(self._admin, "alter_partition_reassignments", None)
-        if alter is None:  # pragma: no cover - version-dependent
+        if alter is None or self._NewPartitionReassignment is None:  # pragma: no cover
             raise RuntimeError(
-                "kafka-python too old: alter_partition_reassignments missing "
-                "(need the KIP-455 admin API)"
+                "kafka-python too old: alter_partition_reassignments / "
+                "NewPartitionReassignment missing (need the KIP-455 admin API)"
             )
         with self._lock:
-            alter({(topic, partition): replicas})
+            alter({
+                self._TopicPartition(topic, partition):
+                    self._NewPartitionReassignment(list(replicas))
+            })
 
     def elect_leader(self, topic: str, partition: int, leader: int) -> None:
         # Preferred-leader election: KIP-460 ElectLeaders
@@ -134,14 +161,17 @@ class KafkaAdminAdapter(AdminAdapter):
         # let the agent report leadership movements complete that never
         # happened. Fail loudly instead.
         elect = getattr(self._admin, "perform_leader_election", None)
-        if elect is None:  # pragma: no cover - version-dependent
+        if elect is None or self._preferred_election is None:  # pragma: no cover
             raise RuntimeError(
-                "kafka-python does not expose perform_leader_election "
-                "(KIP-460); upgrade the client — leadership movements "
-                "cannot be executed correctly without it"
+                "kafka-python does not expose perform_leader_election / "
+                "ElectionType (KIP-460); upgrade the client — leadership "
+                "movements cannot be executed correctly without it"
             )
         with self._lock:
-            elect("PREFERRED", [(topic, partition)])
+            elect(
+                self._preferred_election,
+                [self._TopicPartition(topic, partition)],
+            )
 
     def _in_flight(self) -> Dict[Tuple[str, int], List[int]]:
         lister = getattr(self._admin, "list_partition_reassignments", None)
@@ -155,20 +185,31 @@ class KafkaAdminAdapter(AdminAdapter):
     def reassignment_done(self, topic: str, partition: int) -> bool:
         return (topic, partition) not in self._in_flight()
 
+    def pending_reassignments(self) -> Optional[set]:
+        # one list_partition_reassignments round-trip answers every
+        # executionId in a 'finished' request
+        return set(self._in_flight())
+
     def any_ongoing(self) -> bool:
         return bool(self._in_flight())
 
     def publish_metrics(self, records: List[str]) -> None:
-        for rec in records:
-            self._producer.send(self._metrics_topic, bytes.fromhex(rec))
-        self._producer.flush()
+        # under the adapter lock like every other op: the agent server is
+        # one-thread-per-connection and KafkaConsumer/KafkaProducer are not
+        # safe under concurrent use (a reconnecting transport plus its stale
+        # connection would otherwise interleave on the same client)
+        with self._lock:
+            for rec in records:
+                self._producer.send(self._metrics_topic, bytes.fromhex(rec))
+            self._producer.flush()
 
     def poll_metrics(self, max_records: int) -> List[str]:
         out: List[str] = []
-        for msg in self._consumer:
-            out.append(bytes(msg.value).hex())
-            if len(out) >= max_records:
-                break
+        with self._lock:
+            for msg in self._consumer:
+                out.append(bytes(msg.value).hex())
+                if len(out) >= max_records:
+                    break
         return out
 
     def close(self) -> None:
@@ -201,57 +242,30 @@ class ClusterAgentServer:
                  port: int = 0, ssl_context=None):
         import collections
 
+        from cruise_control_tpu.common.lineserver import JsonLinesServer
+
         self._adapter = adapter
         self._lock = threading.Lock()
         #: executionId -> (topic, partition) still moving; None = leader op
         self._pending: Dict[int, Optional[Tuple[str, int]]] = {}
         self._finished: "collections.OrderedDict" = collections.OrderedDict()
-        agent = self
-
-        class Handler(socketserver.StreamRequestHandler):
-            def setup(self):
-                if ssl_context is not None:
-                    self.request = ssl_context.wrap_socket(
-                        self.request, server_side=True
-                    )
-                super().setup()
-
-            def handle(self):
-                while True:
-                    line = self.rfile.readline()
-                    if not line:
-                        return
-                    try:
-                        req = json.loads(line)
-                        resp = agent._dispatch(req)
-                    except Exception as e:
-                        resp = {"ok": False, "error": repr(e)}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, port), Handler)
-        self._thread: Optional[threading.Thread] = None
+        # transport is the SAME JsonLinesServer the protocol-level test fake
+        # serves on (testing.fake_agent) — framing/TLS changes land once
+        self._server = JsonLinesServer(
+            self._dispatch, host=host, port=port, ssl_context=ssl_context,
+            name="cluster-agent",
+        )
 
     @property
     def address(self) -> Tuple[str, int]:
-        return self._server.server_address
+        return self._server.address
 
     def start(self) -> "ClusterAgentServer":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="cluster-agent", daemon=True
-        )
-        self._thread.start()
+        self._server.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self._server.stop()
         self._adapter.close()
 
     def _dispatch(self, req: Dict) -> Dict:
@@ -280,6 +294,10 @@ class ClusterAgentServer:
             with self._lock:
                 pending = dict(self._pending)
                 finished = set(self._finished)
+            # one bulk listing when the adapter has one (the driver batches
+            # every in-flight id into one request — tcp_driver.poll — so the
+            # per-id fallback would cost one cluster RPC per id)
+            moving = self._adapter.pending_reassignments()
             for eid in req.get("executionIds", ()):
                 eid = int(eid)
                 if eid in finished:
@@ -288,7 +306,11 @@ class ClusterAgentServer:
                 if eid not in pending:
                     continue  # unknown id (restarted driver): unfinished
                 tp = pending[eid]
-                if tp is None or self._adapter.reassignment_done(*tp):
+                if tp is None or (
+                    tp not in moving
+                    if moving is not None
+                    else self._adapter.reassignment_done(*tp)
+                ):
                     done.append(eid)
             with self._lock:
                 for eid in done:

@@ -92,10 +92,15 @@ def size_bucket(n: int) -> int:
     Keyed into the goal-step compile cache through `Dims`, this keeps churn
     (partition create/delete, topic add/remove) from recompiling the whole
     goal stack: any size inside the same bucket reuses the padded program.
-    Padding overhead is bounded at 12.5%; tiny fixtures (<= 64) are left exact.
+    Padding overhead is bounded at 12.5%; tiny fixtures (<= 32) are left
+    exact. The 32..64 range buckets to 64 so the seeded ~60-partition models
+    that several test modules share (test_executor / test_facade_detector /
+    test_rest) key to ONE compiled stack program instead of three.
     """
-    if n <= 64:
+    if n <= 32:
         return n
+    if n <= 64:
+        return 64
     step = max(8, 1 << (n.bit_length() - 4))
     return ((n + step - 1) // step) * step
 

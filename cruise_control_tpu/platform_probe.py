@@ -65,34 +65,12 @@ def pin_cpu(device_count: int | None = None) -> None:
         pass
 
 
-def probe_default_backend(timeout_s: float = 75.0) -> str | None:
+def probe_default_backend(timeout_s: float = 75.0, env=None) -> str | None:
     """Return the default backend's platform name, or None if init hangs/fails.
 
     Runs in a subprocess so a hanging backend init can be killed; the parent
     process never touches the backend until the probe verdict is in.
     """
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices(); print(jax.default_backend())"],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
-        )
-    except subprocess.TimeoutExpired:
-        return None
-    if out.returncode != 0:
-        return None
-    name = out.stdout.strip().splitlines()
-    return name[-1] if name else None
-
-
-def probe_only(timeout_s: float = 75.0) -> str | None:
-    """One subprocess probe of the DEFAULT platform, touching nothing in this
-    process — safe to call even after the caller pinned CPU (the subprocess
-    gets a cleaned environment so the parent's pin does not leak in). Used to
-    re-check a dead tunnel between benchmark stages."""
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
     try:
         out = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices(); print(jax.default_backend())"],
@@ -109,15 +87,28 @@ def probe_only(timeout_s: float = 75.0) -> str | None:
     return name[-1] if name else None
 
 
+def probe_only(timeout_s: float = 75.0) -> str | None:
+    """One subprocess probe of the DEFAULT platform, touching nothing in this
+    process — safe to call even after the caller pinned CPU (the subprocess
+    gets a cleaned environment so the parent's pin does not leak in). Used to
+    re-check a dead tunnel between benchmark stages."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    return probe_default_backend(timeout_s, env=env)
+
+
 def ensure_live_backend(timeout_s: float = 75.0, log=None,
-                        retries: int = 3, backoff_s: float = 10.0) -> ProbeResult:
+                        retries: int = 1, backoff_s: float = 10.0) -> ProbeResult:
     """Guarantee the in-process backend will init promptly; return the verdict.
 
     The default platform (TPU under axon) is probed in a subprocess up to
-    ``retries`` times with ``backoff_s`` sleeps between attempts — a tunnel
-    that hiccups at minute 0 must not silently convert a benchmark's headline
-    into a CPU number. If any probe succeeds, nothing is changed; otherwise
-    the process is pinned to CPU and the result says ``fallback=True``.
+    ``retries`` times with ``backoff_s`` sleeps between attempts. The default
+    is ONE probe — interactive service startup (main.py) should degrade to
+    CPU after a single timeout, not block for minutes. Benchmarks opt into
+    retries explicitly (bench.py, BENCH_PROBE_RETRIES): a tunnel that hiccups
+    at minute 0 must not silently convert the headline into a CPU number. If
+    any probe succeeds, nothing is changed; otherwise the process is pinned
+    to CPU and the result says ``fallback=True``.
     """
     if log is None:
         def log(msg):  # pragma: no cover - trivial default

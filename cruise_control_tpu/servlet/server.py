@@ -561,11 +561,15 @@ class CruiseControlApp:
                             raise web.HTTPNotFound()
                         return web.FileResponse(path)
 
-                    base = os.path.abspath(webui_dir)
+                    # realpath, not abspath: a symlink inside the UI dir must
+                    # not escape the base-directory check (matches aiohttp's
+                    # add_static follow_symlinks=False posture on the
+                    # non-root branch)
+                    base = os.path.realpath(webui_dir)
 
                     async def static_file(request):
                         rel = request.match_info["tail"]
-                        path = os.path.abspath(os.path.join(base, rel))
+                        path = os.path.realpath(os.path.join(base, rel))
                         if not path.startswith(base + os.sep):
                             raise web.HTTPForbidden()  # traversal guard
                         if not os.path.isfile(path):

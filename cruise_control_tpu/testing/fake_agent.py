@@ -14,15 +14,18 @@ integration test deterministic while every byte still crosses a real socket.
 
 from __future__ import annotations
 
-import json
-import socket
-import socketserver
 import threading
-from typing import Dict, Optional, Tuple
+from typing import Dict, Tuple
+
+from cruise_control_tpu.common.lineserver import JsonLinesServer
 
 
 class FakeClusterAgent:
-    """JSON-lines TCP server applying reassignments to a SimulatedCluster."""
+    """JSON-lines TCP server applying reassignments to a SimulatedCluster.
+
+    Transport (threaded socket loop, TLS termination — the SslTest analog)
+    is the SHARED JsonLinesServer, the same scaffolding the production
+    Kafka agent serves on; only the dispatch differs."""
 
     def __init__(self, sim, latency_polls: int = 0, host: str = "127.0.0.1",
                  ssl_context=None):
@@ -33,54 +36,21 @@ class FakeClusterAgent:
         self._pending: Dict[int, Tuple[str, Dict, int]] = {}
         self._finished: set = set()
         self._metrics: list = []  # hex-encoded records, consumed by poll
-        agent = self
-
-        class Handler(socketserver.StreamRequestHandler):
-            def setup(self):
-                # TLS termination on the agent socket (the SslTest analog:
-                # the reference integration-tests its reporter under SSL)
-                if ssl_context is not None:
-                    self.request = ssl_context.wrap_socket(
-                        self.request, server_side=True
-                    )
-                super().setup()
-
-            def handle(self):
-                while True:
-                    line = self.rfile.readline()
-                    if not line:
-                        return
-                    try:
-                        req = json.loads(line)
-                        resp = agent._dispatch(req)
-                    except Exception as e:  # protocol fakes must not die quietly
-                        resp = {"ok": False, "error": repr(e)}
-                    self.wfile.write(json.dumps(resp).encode() + b"\n")
-                    self.wfile.flush()
-
-        class Server(socketserver.ThreadingTCPServer):
-            allow_reuse_address = True
-            daemon_threads = True
-
-        self._server = Server((host, 0), Handler)
-        self._thread: Optional[threading.Thread] = None
+        self._server = JsonLinesServer(
+            self._dispatch, host=host, ssl_context=ssl_context,
+            name="fake-cluster-agent",
+        )
 
     @property
     def address(self) -> Tuple[str, int]:
-        return self._server.server_address
+        return self._server.address
 
     def start(self) -> "FakeClusterAgent":
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, name="fake-cluster-agent", daemon=True
-        )
-        self._thread.start()
+        self._server.start()
         return self
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        self._server.stop()
 
     # -- protocol ops ----------------------------------------------------------
 

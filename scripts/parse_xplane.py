@@ -1,0 +1,47 @@
+"""Parse a JAX profiler xplane capture into a per-op time table (dev tool).
+
+Usage: python scripts/parse_xplane.py /tmp/jaxtrace
+Finds the newest *.xplane.pb under the trace dir and prints the op_profile /
+framework_op_stats tool output as a ranked table (top self-time ops), so TPU
+hot spots are readable without TensorBoard.
+"""
+
+import glob
+import json
+import os
+import sys
+
+
+def main() -> None:
+    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/jaxtrace"
+    paths = sorted(
+        glob.glob(os.path.join(root, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        sys.exit(f"no .xplane.pb under {root}")
+    path = paths[-1]
+    print(f"parsing {path} ({os.path.getsize(path)/1e6:.1f} MB)", flush=True)
+
+    from xprof.convert import raw_to_tool_data as r2t
+
+    params = {"tqx": "out:csv;"}
+    for tool in ("framework_op_stats", "op_profile"):
+        try:
+            data, _ = r2t.xspace_to_tool_data([path], tool, params)
+        except Exception as e:  # tool coverage varies by capture type
+            print(f"-- {tool}: failed: {type(e).__name__}: {e}")
+            continue
+        out = os.path.join(root, f"{tool}.out")
+        mode = "wb" if isinstance(data, bytes) else "w"
+        with open(out, mode) as f:
+            f.write(data)
+        print(f"-- {tool}: wrote {out}")
+        if tool == "framework_op_stats" and isinstance(data, (str, bytes)):
+            text = data.decode() if isinstance(data, bytes) else data
+            lines = text.splitlines()
+            print("\n".join(lines[:40]))
+
+
+if __name__ == "__main__":
+    main()

@@ -220,6 +220,43 @@ class TestFullStack:
             assert gf.violated_brokers_after == gc.violated_brokers_after, gf.name
             assert gf.cost_after == pytest.approx(gc.cost_after), gf.name
 
+    def test_polish_pass_never_regresses(self, random_model):
+        """polish_rounds > 0 re-runs every goal under the FULL merged table
+        set after the stack completes (OptimizerSettings.polish_rounds): no
+        goal's violated-broker count may exceed the single-pass run's (every
+        polish action satisfies every goal's contributed bounds) and hard
+        goals still hold. Runs the chunked machine — its polish phases reuse
+        the main pass's traced branches, so this costs one normal-size
+        compile (the fused second traversal doubles the program; its
+        equivalence check lives in the slow lane)."""
+        base = GoalOptimizer().optimizations(random_model)
+        polished = GoalOptimizer(
+            settings=OptimizerSettings(polish_rounds=8, chunk_rounds=2)
+        ).optimizations(random_model)
+        fixed = random_model._replace(assignment=polished.final_assignment)
+        sanity_check(fixed)
+        after = _violations(fixed)
+        for name in HARD_GOAL_NAMES:
+            assert after[name] == 0, f"hard goal {name} violated after polish"
+        for gb, gp in zip(base.goal_results, polished.goal_results):
+            assert gp.violated_brokers_after <= gb.violated_brokers_after, gb.name
+
+    @pytest.mark.slow
+    def test_polish_fused_equals_chunked(self, random_model):
+        """The fused stack's polish traversal must match the chunked
+        machine's polish phases (same kernels, same order). Slow lane: the
+        fused-polish program traces every goal loop twice."""
+        fused = GoalOptimizer(
+            settings=OptimizerSettings(polish_rounds=8)
+        ).optimizations(random_model)
+        chunked = GoalOptimizer(
+            settings=OptimizerSettings(polish_rounds=8, chunk_rounds=2)
+        ).optimizations(random_model)
+        assert np.array_equal(fused.final_assignment, chunked.final_assignment)
+        for gf, gc in zip(fused.goal_results, chunked.goal_results):
+            assert gf.cost_after == pytest.approx(gc.cost_after), gf.name
+            assert gf.violated_brokers_after == gc.violated_brokers_after, gf.name
+
 
 class TestOptions:
     def test_excluded_partitions_never_move(self):

@@ -30,7 +30,9 @@ from cruise_control_tpu.servlet.server import CruiseControlApp
 from cruise_control_tpu.servlet.user_tasks import UserTaskManager
 from cruise_control_tpu.testing.simulator import SimulatedCluster
 
-FAST = OptimizerSettings(batch_k=16, max_rounds_per_goal=6, num_dst_candidates=3)
+# identical to test_executor/test_facade_detector's FAST so the three modules
+# share one compiled stack program (conftest keeps caches warm across modules)
+FAST = OptimizerSettings(batch_k=16, max_rounds_per_goal=8, num_dst_candidates=3)
 
 
 def _free_port() -> int:

@@ -612,11 +612,18 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             # uses the chunked machine, where the polish phases reuse the
             # same traced branches
             for i, (goal, loop) in enumerate(zip(goals, loops)):
+                gs_now = goal.prepare(static, agg, dims)
+                cost_now = goal.cost(static, gs_now, agg).astype(jnp.float32)
+                # retry only when later goals' moves changed this goal's
+                # state after it stalled (mirrors the chunked machine's
+                # skip_polish)
+                skip = cv[i] & (cost_now == ca[i])
                 agg, rounds, empties = loop(
-                    static, agg, tables, jnp.int32(settings.polish_rounds)
+                    static, agg, tables,
+                    jnp.where(skip, jnp.int32(0), jnp.int32(settings.polish_rounds)),
                 )
                 rs[i] = rs[i] + rounds
-                cv[i] = empties >= loop.empties_to_stall
+                cv[i] = jnp.where(skip, cv[i], empties >= loop.empties_to_stall)
             for i, goal in enumerate(goals):
                 gs1 = goal.prepare(static, agg, dims)
                 va[i] = jnp.sum(
@@ -736,15 +743,34 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                         jnp.float32(settings.rounds_ceiling),
                     )
                     cap_g = scaled.astype(jnp.int32)
+                skip_polish = jnp.asarray(False)
                 if settings.polish_rounds > 0:
+                    # a polish retry can only find new actions when LATER
+                    # goals' moves changed this goal's state after it
+                    # stalled (fuller tables only restrict); identical cost
+                    # + a converged main pass => nothing to retry, skip the
+                    # stall-detection rounds (8 empty grid evaluations for
+                    # rotated goals)
+                    skip_polish = (
+                        polishing
+                        & metrics_b.converged[gim]
+                        & (cost_in == metrics_b.cost_after[gim])
+                    )
                     cap_g = jnp.where(polishing, jnp.int32(settings.polish_rounds), cap_g)
+                    cap_g = jnp.where(skip_polish, jnp.int32(0), cap_g)
                 budget_g = jnp.minimum(left, cap_g - rig)
                 agg2, rounds, emp2 = loop(
                     static, agg_b, tables_b, budget_g,
                     rnd_base=rig, empties0=emp,
                 )
                 rig2 = rig + rounds
-                stalled = emp2 >= loop.empties_to_stall
+                # a skipped polish phase keeps the main pass's converged
+                # verdict (its 0-round budget would read as cap-bound)
+                stalled = jnp.where(
+                    skip_polish,
+                    metrics_b.converged[gim],
+                    emp2 >= loop.empties_to_stall,
+                )
                 done_goal = stalled | (rig2 >= cap_g)
                 gs_out = goal.prepare(static, agg2, dims)
                 viol_out = jnp.sum(
@@ -1179,6 +1205,12 @@ class GoalOptimizer:
                 empty_stack_metrics(len(goal_names_t)), jnp.int32(1),
             )
             jax.block_until_ready(out[6])
+            if self._settings.polish_rounds > 0:
+                # the final-state re-measure runs in every polished
+                # optimizations() call; compile it here, not in the timed run
+                jax.block_until_ready(
+                    _cached_measure(goal_names_t, dims)(static, agg)
+                )
         else:
             step = _stack_executable(
                 goal_names_t, dims, self._settings, self._mesh, static, agg

@@ -133,7 +133,13 @@ class KafkaAdminAdapter(AdminAdapter):
             consumer_timeout_ms=500,
         )
         self._metrics_topic = metrics_topic
-        self._lock = threading.Lock()
+        # per-client locks: the admin and consumer clients each need
+        # serialization against THEMSELVES only (KafkaConsumer forbids
+        # concurrent use; admin ops share one connection), while
+        # KafkaProducer is documented thread-safe — one shared lock would
+        # make every status RPC queue behind a 500 ms consumer poll window
+        self._admin_lock = threading.Lock()
+        self._consumer_lock = threading.Lock()
 
     def begin_reassignment(self, topic: str, partition: int, replicas: List[int]) -> None:
         # KIP-455 AlterPartitionReassignments — the post-ZK form of
@@ -146,7 +152,7 @@ class KafkaAdminAdapter(AdminAdapter):
                 "kafka-python too old: alter_partition_reassignments / "
                 "NewPartitionReassignment missing (need the KIP-455 admin API)"
             )
-        with self._lock:
+        with self._admin_lock:
             alter({
                 self._TopicPartition(topic, partition):
                     self._NewPartitionReassignment(list(replicas))
@@ -167,7 +173,7 @@ class KafkaAdminAdapter(AdminAdapter):
                 "ElectionType (KIP-460); upgrade the client — leadership "
                 "movements cannot be executed correctly without it"
             )
-        with self._lock:
+        with self._admin_lock:
             elect(
                 self._preferred_election,
                 [self._TopicPartition(topic, partition)],
@@ -179,7 +185,7 @@ class KafkaAdminAdapter(AdminAdapter):
             raise RuntimeError(
                 "kafka-python too old: list_partition_reassignments missing"
             )
-        with self._lock:
+        with self._admin_lock:
             return dict(lister() or {})
 
     def reassignment_done(self, topic: str, partition: int) -> bool:
@@ -194,18 +200,16 @@ class KafkaAdminAdapter(AdminAdapter):
         return bool(self._in_flight())
 
     def publish_metrics(self, records: List[str]) -> None:
-        # under the adapter lock like every other op: the agent server is
-        # one-thread-per-connection and KafkaConsumer/KafkaProducer are not
-        # safe under concurrent use (a reconnecting transport plus its stale
-        # connection would otherwise interleave on the same client)
-        with self._lock:
-            for rec in records:
-                self._producer.send(self._metrics_topic, bytes.fromhex(rec))
-            self._producer.flush()
+        # KafkaProducer is thread-safe; no lock needed
+        for rec in records:
+            self._producer.send(self._metrics_topic, bytes.fromhex(rec))
+        self._producer.flush()
 
     def poll_metrics(self, max_records: int) -> List[str]:
+        # KafkaConsumer forbids concurrent use (a reconnecting transport
+        # plus its stale connection would otherwise interleave on it)
         out: List[str] = []
-        with self._lock:
+        with self._consumer_lock:
             for msg in self._consumer:
                 out.append(bytes(msg.value).hex())
                 if len(out) >= max_records:
@@ -296,8 +300,11 @@ class ClusterAgentServer:
                 finished = set(self._finished)
             # one bulk listing when the adapter has one (the driver batches
             # every in-flight id into one request — tcp_driver.poll — so the
-            # per-id fallback would cost one cluster RPC per id)
-            moving = self._adapter.pending_reassignments()
+            # per-id fallback would cost one cluster RPC per id); fetched
+            # lazily so requests probing only leader ops / stale ids cost
+            # zero admin round-trips
+            moving: Optional[set] = None
+            moving_fetched = False
             for eid in req.get("executionIds", ()):
                 eid = int(eid)
                 if eid in finished:
@@ -306,6 +313,9 @@ class ClusterAgentServer:
                 if eid not in pending:
                     continue  # unknown id (restarted driver): unfinished
                 tp = pending[eid]
+                if tp is not None and not moving_fetched:
+                    moving = self._adapter.pending_reassignments()
+                    moving_fetched = True
                 if tp is None or (
                     tp not in moving
                     if moving is not None

@@ -377,7 +377,8 @@ def apply_action(static: StaticCtx, agg: Aggregates, act: ActionBatch, apply_fla
 
 
 def wave_select(score, src, dst, dst_host, valid, num_brokers: int, num_hosts: int,
-                dst_host2=None, parts=(), num_partitions: int = 0):
+                dst_host2=None, parts=(), num_partitions: int = 0,
+                brokers3=None):
     """bool[N]: a conflict-free, score-prioritized subset of candidate actions.
 
     Contract: among selected entries, every broker appears in at most ONE
@@ -433,6 +434,11 @@ def wave_select(score, src, dst, dst_host, valid, num_brokers: int, num_hosts: i
             c_and = sel
         return sel
 
+    # a THIRD broker endpoint (leadership relays touch b, d and e): enforce
+    # the same per-broker uniqueness over all three claim arrays
+    if brokers3 is not None:
+        b3_c = jnp.where(valid, brokers3, num_brokers)
+        sel = unique_per_group(sel, [src_c, dst_c, b3_c], num_brokers)
     # at most one action lands per destination host per wave (swaps load both
     # ends, so they pass both endpoint hosts)
     hosts = [h for h in (dst_host, dst_host2) if h is not None]

@@ -605,178 +605,214 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
     return swap_round
 
 
-def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
-                               apply_waves: int):
-    """Leadership-swap fallback for leader-load goals (LeaderBytesIn): when
-    plain promotions stall, EXCHANGE leadership between an over-bound broker
-    and a neighbor — promote a heavy leader p1 of over-broker b to its
-    follower at d, and promote a light leader p2 of d to its follower at b.
+def make_leadership_relay_round(goal, dims, n_src: int, k_out: int, k_ret: int,
+                                apply_waves: int):
+    """Leadership-RELAY fallback for leader-load goals (LeaderBytesIn): when
+    plain promotions stall, pair a heavy promotion off an over-bound broker
+    with a light promotion off its destination — promote heavy leader p1 of
+    over-broker b to its follower at d, and promote one of d's LIGHT leaders
+    p2 to p2's follower at any broker e.
 
-    Why swaps: near convergence the leader-count goal's bounds (hi_lead /
-    lo_lead, cc/analyzer/goals/LeaderReplicaDistributionGoal.java) veto every
-    single promotion (+-1 leader at each endpoint), and the usage bands veto
-    the full leader-load transfer. A leadership swap is COUNT-NEUTRAL at both
-    endpoints, and its net load transfer is the difference of the two
-    partitions' leader loads — tiny when the return partition is chosen close
-    in weight — so both table families pass where every single action fails.
-    The reference has no leadership swap (LeaderBytesInDistributionGoal.java
-    :39 only relocates leadership one partition at a time and simply leaves
-    these states); the parity gate only requires not being worse.
+    Why relays: near convergence the leader-count goal's bounds (hi_lead /
+    lo_lead, cc/analyzer/goals/LeaderReplicaDistributionGoal.java) and the
+    usage bands veto every single promotion; the pairing keeps d COUNT-
+    NEUTRAL and its net load gain is the difference of the two partitions'
+    leader loads. The e == b case is a pure leadership SWAP (count-neutral
+    at both endpoints — the round-4 fallback); the general e ≠ b case is
+    what makes the fallback work at north-star scale: a partition with
+    leader at d AND follower back at b is vanishingly rare at 2,600 brokers
+    (~P*rf/B^2 ≈ 0.06 per ordered pair), while d always has light leaders
+    whose followers live SOMEWHERE (~P/B ≈ 77 leaders per broker). The
+    reference has no compound leadership action
+    (LeaderBytesInDistributionGoal.java:39 relocates leadership one
+    partition at a time and simply leaves these states); the parity gate
+    only requires not being worse.
 
     Per round: top-V over-bound sources by src_rank x their K1 heaviest
-    leaders x each leader's R-1 follower brokers d x d-leader return
-    candidates (the K2 lightest follower slots AT b by their partition's
-    leader weight, joined on leader == d), validated exactly (structural,
-    prior-goal net tables, goal-cost improvement), applied in
-    endpoint-disjoint waves.
+    leaders x each leader's R-1 follower brokers d x d's K2 lightest leaders
+    (per-broker table, round-jittered) x those leaders' R-1 follower slots
+    (e), validated exactly (structural, per-endpoint prior-goal bounds with
+    e == b aliasing folded into b's net, combined host-CPU, goal-cost
+    improvement), applied in waves disjoint over all three brokers, both
+    hosts gaining load, and both partitions.
     """
     p_count, r = dims.num_partitions, dims.max_rf
     b_count = dims.num_brokers
     v = max(1, min(n_src, b_count))
     k1 = max(1, min(k_out, p_count))
-    k2 = max(1, min(k_in, p_count))
+    k2 = max(1, min(k_ret, p_count))
     r_f = r - 1  # follower slots per candidate leader
 
+    from cruise_control_tpu.analyzer.goals.base import imbalance
     from cruise_control_tpu.common.resources import PartMetric
 
-    def net_tables_ok(static, tables, agg_c, b, d, net_load, net_lnw):
-        """Net-effect table check for a leadership swap b <-> d: per-resource
-        load band + hard box + leader bytes-in + host CPU. Leader counts,
-        replica counts, topic counts, potential NW_OUT and rack safety are
-        unchanged by construction (both legs transfer leadership only)."""
-        inc = net_load > 0.0
-        ok = jnp.all(
-            ~inc | (agg_c.broker_load[d] + net_load <= tables.hi_load[d]),
-            axis=-1,
-        )
-        ok &= jnp.all(
-            (net_load >= 0.0)
-            | (agg_c.broker_load[b] - net_load <= tables.hi_load[b]),
-            axis=-1,
-        )
-        not_dead = jnp.zeros(jnp.broadcast_shapes(b.shape, d.shape), dtype=bool)
-        ok &= band_move_acceptance(tables, agg_c, b, d, net_load, not_dead)
-        ok &= (net_lnw <= 0.0) | (
-            agg_c.leader_nw_in[d] + net_lnw <= tables.hi_lnw[d]
-        )
-        ok &= (net_lnw >= 0.0) | (
-            agg_c.leader_nw_in[b] - net_lnw <= tables.hi_lnw[b]
-        )
-        dcpu = net_load[..., 0]
-        host_b = static.broker_host[b]
-        host_d = static.broker_host[d]
-        same_host = host_b == host_d
-        ok &= same_host | (dcpu <= 0.0) | (
-            agg_c.host_cpu_load[host_d] + dcpu <= tables.hi_host_cpu[host_d]
-        )
-        ok &= same_host | (dcpu >= 0.0) | (
-            agg_c.host_cpu_load[host_b] - dcpu <= tables.hi_host_cpu[host_b]
-        )
+    def endpoint_ok(static, tables, agg_c, x, dload, dlnw, dcnt):
+        """Conservative per-endpoint bound checks for broker x: hard load
+        box, distribution-band box (no pairwise shrink escape — a relay has
+        three endpoints, so the two-case band check does not apply; box-only
+        rejects some legal relays but never accepts an illegal one), leader
+        bytes-in cap, leader-count box. Replica/topic counts, potential
+        NW_OUT and rack safety are unchanged by construction (both legs
+        transfer leadership only). Host CPU is checked COMBINED by the
+        caller (endpoints may share hosts)."""
+        inc = dload > 0.0
+        after = agg_c.broker_load[x] + dload
+        ok = jnp.all(~inc | (after <= tables.hi_load[x]), axis=-1)
+        band = jnp.where(inc, after <= tables.band_hi[x], after >= tables.band_lo[x])
+        ok &= jnp.all((dload == 0.0) | ~tables.band_on | band, axis=-1)
+        ok &= (dlnw <= 0.0) | (agg_c.leader_nw_in[x] + dlnw <= tables.hi_lnw[x])
+        cnt_after = agg_c.leader_count[x] + dcnt
+        ok &= (dcnt <= 0.0) | (cnt_after <= tables.hi_lead[x])
+        ok &= (dcnt >= 0.0) | (cnt_after >= tables.lo_lead[x])
         return ok
 
     def validate(static, agg_c, tables, gs, p1, s1, b, p2, s2, d):
-        """(ok, improvement) for swap cells of any common shape: leadership
-        of p1 moves b -> d (promote p1's follower slot s1 at d) while
-        leadership of p2 moves d -> b (promote p2's follower slot s2 at b)."""
+        """(ok, improvement, act1, act2, e) for relay cells of any common
+        shape: leadership of p1 moves b -> d (promote p1's follower slot s1)
+        and leadership of p2 moves d -> e = assignment[p2, s2]."""
         a = agg_c.assignment
+        e_raw = a[p2, s2]
+        e = jnp.maximum(e_raw, 0)
         still = (a[p1, 0] == b) & (a[p1, s1] == d)
-        still &= (a[p2, 0] == d) & (a[p2, s2] == b)
-        still &= (b != d) & (p1 != p2) & (s1 >= 1) & (s2 >= 1)
+        still &= (a[p2, 0] == d) & (e_raw >= 0)
+        still &= (b != d) & (d != e) & (p1 != p2) & (s1 >= 1) & (s2 >= 1)
         still &= static.movable_partition[p1] & static.movable_partition[p2]
-        still &= static.leadership_dst_ok[d] & static.leadership_dst_ok[b]
+        still &= static.leadership_dst_ok[d] & static.leadership_dst_ok[e]
         still &= ~static.only_move_immigrants
         act1 = build_selected(
             static.part_load, a, p1, jnp.int32(KIND_LEADERSHIP), s1, d
         )
         act2 = build_selected(
-            static.part_load, a, p2, jnp.int32(KIND_LEADERSHIP), s2, b
+            static.part_load, a, p2, jnp.int32(KIND_LEADERSHIP), s2, e
         )
-        net_load = act1.dload - act2.dload  # [..., 4] net gain at d
-        net_lnw = act1.dleader_nw_in - act2.dleader_nw_in
-        still &= net_tables_ok(static, tables, agg_c, b, d, net_load, net_lnw)
-        # goal improvement on the two touched brokers (cost is a sum of
-        # per-broker out-of-window distances, so the delta is local)
-        from cruise_control_tpu.analyzer.goals.base import imbalance
+        # per-broker net deltas with the e == b alias folded into b
+        eb = e == b
+        ebl = eb[..., None]
+        dl1, dl2 = act1.dload, act2.dload
+        w1, w2 = act1.dleader_nw_in, act2.dleader_nw_in
+        delta_b = -dl1 + jnp.where(ebl, dl2, 0.0)
+        delta_d = dl1 - dl2
+        delta_e = jnp.where(ebl, 0.0, dl2)
+        lnw_b = -w1 + jnp.where(eb, w2, 0.0)
+        lnw_d = w1 - w2
+        lnw_e = jnp.where(eb, 0.0, w2)
+        cnt_b = jnp.where(eb, 0, -1)
+        cnt_e = jnp.where(eb, 0, 1)
+        still &= endpoint_ok(static, tables, agg_c, b, delta_b, lnw_b, cnt_b)
+        still &= endpoint_ok(static, tables, agg_c, d, delta_d, lnw_d, 0)
+        still &= endpoint_ok(static, tables, agg_c, e, delta_e, lnw_e, cnt_e)
+        # host CPU combined per touched host (endpoints may share hosts)
+        cb, cd, ce = delta_b[..., 0], delta_d[..., 0], delta_e[..., 0]
+        hb = static.broker_host[b]
+        hd = static.broker_host[d]
+        he = static.broker_host[e]
 
-        lnw_b = agg_c.leader_nw_in[b]
-        lnw_d = agg_c.leader_nw_in[d]
-        before = imbalance(lnw_b, gs.lower, gs.upper) + imbalance(
-            lnw_d, gs.lower, gs.upper
+        def host_ok(h):
+            tot = (
+                jnp.where(hb == h, cb, 0.0)
+                + jnp.where(hd == h, cd, 0.0)
+                + jnp.where(he == h, ce, 0.0)
+            )
+            return (tot <= 0.0) | (
+                agg_c.host_cpu_load[h] + tot <= tables.hi_host_cpu[h]
+            )
+
+        still &= host_ok(hb) & host_ok(hd) & host_ok(he)
+        # goal improvement over the distinct endpoints (cost is a sum of
+        # per-broker out-of-window distances, so the delta is local)
+        lnwv = agg_c.leader_nw_in
+        before = (
+            imbalance(lnwv[b], gs.lower, gs.upper)
+            + imbalance(lnwv[d], gs.lower, gs.upper)
+            + jnp.where(eb, 0.0, imbalance(lnwv[e], gs.lower, gs.upper))
         )
-        after = imbalance(lnw_b - net_lnw, gs.lower, gs.upper) + imbalance(
-            lnw_d + net_lnw, gs.lower, gs.upper
+        after = (
+            imbalance(lnwv[b] + lnw_b, gs.lower, gs.upper)
+            + imbalance(lnwv[d] + lnw_d, gs.lower, gs.upper)
+            + jnp.where(eb, 0.0, imbalance(lnwv[e] + lnw_e, gs.lower, gs.upper))
         )
         improvement = before - after
         ok = still & (improvement > 1e-6)
-        return ok, improvement, act1, act2
+        return ok, improvement, act1, act2, e
 
-    def lead_swap_round(static: StaticCtx, agg: Aggregates, tables, gs, rnd):
+    def relay_round(static: StaticCtx, agg: Aggregates, tables, gs, rnd):
         rank = goal.src_rank(static, gs, agg)
-        # dead brokers never need swaps (evacuation moves handle them) and
-        # cannot receive the return promotion; exclude outright
+        # dead brokers never need relays (evacuation moves handle them);
+        # exclude outright
         rank = jnp.where(static.dead, -jnp.inf, rank)
         _, hot = jax.lax.top_k(rank, v)
         hot = hot.astype(jnp.int32)
         hot_ok = jnp.isfinite(rank[hot])
 
-        # K1 heaviest leaders per source (drain_contrib is finite only on
-        # leader slots for leader-load goals), round-jittered so a uniformly
-        # frozen head cannot starve the fallback
-        contrib = goal.drain_contrib(static, gs, agg)
+        # K1 leaders per source whose weight is CLOSEST to the broker's
+        # excess over the upper window: the ideal first leg transfers just
+        # enough to bring b under the bound without overshooting d — near
+        # convergence the heaviest leader usually overshoots every
+        # destination while a mid-weight one fits (the plain-promotion
+        # shortlist learns this from exact scores; a compound action's
+        # candidates must encode it in the rank). Round-jittered so a
+        # uniformly-frozen head cannot starve the fallback.
         rot = round_jitter(p_count, rnd)
-        contrib = contrib * rot[:, None]
-        c1p, c1s, c1ok = heavy_picks(static, agg, contrib, hot, k1, b_count)
+        w_all = static.part_load[:, PartMetric.NW_IN_LEADER]
+        is_leader = (jnp.arange(r) == 0)[None, :]
+        excess = jnp.maximum(agg.leader_nw_in - gs.upper, 0.0)
+        lead_broker = agg.assignment[:, 0]
+        closeness = -jnp.abs(w_all - excess[jnp.maximum(lead_broker, 0)])
+        contrib = jnp.where(is_leader, (closeness * rot)[:, None], -jnp.inf)
+        c1p, _, c1ok = heavy_picks(static, agg, contrib, hot, k1, b_count)
         c1ok = c1ok & hot_ok[:, None]
 
-        # K2 return candidates per source: follower slots AT the source whose
-        # partition's leader (somewhere else) is LIGHT — promoting one back
-        # into the source is the swap's second leg. Selection weight is the
-        # partition's leader-borne goal metric; the join on the first leg's
-        # destination happens in the grid.
-        w_all = static.part_load[:, PartMetric.NW_IN_LEADER]
-        is_follower = (jnp.arange(r) >= 1)[None, :]
-        ret_contrib = jnp.where(is_follower, w_all[:, None], -jnp.inf)
-        ret_contrib = ret_contrib * rot[:, None]
-        c2p, c2s, c2ok = light_picks(static, agg, ret_contrib, hot, k2, b_count)
-
-        # grid [V, K1, R-1, K2]: first leg (p1 -> its s1-th follower broker),
-        # joined against return candidates whose leader IS that broker.
-        # Lazy broadcast shapes (see make_drain_round): each index array
-        # keeps only the axes it varies over, so gathers stay [V,K1,·]- or
-        # [V,·,K2]-sized; only g_d is genuinely joint ([V, K1, R-1, 1]).
-        full = (v, k1, r_f, k2)
-        g_p1 = c1p[:, :, None, None]
-        s1_all = jnp.arange(1, r, dtype=jnp.int32)
-        g_s1 = s1_all[None, None, :, None]
-        g_b = hot[:, None, None, None]
-        g_p2 = c2p[:, None, None, :]
-        g_s2 = c2s[:, None, None, :]
-        g_d = agg.assignment[g_p1, g_s1]  # first-leg destination [V,K1,R-1,1]
-        g_ok = (
-            c1ok[:, :, None, None]
-            & c2ok[:, None, None, :]
-            & (g_d >= 0)
-            & (agg.assignment[g_p2, 0] == g_d)  # the join
+        # per-broker K2 leader candidates for the relay's second leg: half
+        # LIGHTEST and half HEAVIEST leaders led by each broker — the light
+        # end sheds just enough for d to absorb a small overshoot, the heavy
+        # end lets d pass on most of the incoming load; exact validation
+        # picks what the bounds accept. Same jitter family as leg 1 so the
+        # slices interleave across rounds.
+        lead_w = jnp.where(is_leader, w_all[:, None], -jnp.inf)
+        lead_w = lead_w * rot[:, None]
+        k2l = max(1, k2 // 2)
+        lp, _, lok = broker_top_replicas(
+            static, agg, lead_w, k2l, b_count, heaviest=False
         )
-        ok, improve, _, _ = validate(
-            static, agg, tables, gs, g_p1, g_s1, g_b, g_p2, g_s2,
-            jnp.maximum(g_d, 0),
+        if k2 - k2l > 0:
+            hp, _, hok = broker_top_replicas(
+                static, agg, lead_w, k2 - k2l, b_count, heaviest=True
+            )
+            ret_p = jnp.concatenate([lp, hp], axis=1)  # [B, K2]
+            ret_ok = jnp.concatenate([lok, hok], axis=1)
+        else:  # k2 == 1: the light pick is the whole candidate set
+            ret_p, ret_ok = lp, lok
+
+        # grid [V, K1, R-1 (s1), K2, R-1 (s2)], lazy broadcast shapes (see
+        # make_drain_round): only g_p2 / g_s2-derived arrays are joint
+        full = (v, k1, r_f, k2, r_f)
+        s1_all = jnp.arange(1, r, dtype=jnp.int32)
+        g_p1 = c1p[:, :, None, None, None]
+        g_s1 = s1_all[None, None, :, None, None]
+        g_b = hot[:, None, None, None, None]
+        g_d = agg.assignment[g_p1, g_s1]  # [V,K1,R-1,1,1]
+        g_d0 = jnp.maximum(g_d, 0)
+        k2i = jnp.arange(k2, dtype=jnp.int32)[None, None, None, :, None]
+        g_p2 = ret_p[g_d0, k2i]  # [V,K1,R-1,K2,1]
+        g_p2ok = ret_ok[g_d0, k2i]
+        g_s2 = s1_all[None, None, None, None, :]
+        g_ok = c1ok[:, :, None, None, None] & (g_d >= 0) & g_p2ok
+        ok, improve, _, _, _ = validate(
+            static, agg, tables, gs, g_p1, g_s1, g_b, g_p2, g_s2, g_d0
         )
         score0 = jnp.broadcast_to(jnp.where(ok & g_ok, improve, -jnp.inf), full)
-        n_cells = k1 * r_f * k2
+        n_cells = k1 * r_f * k2 * r_f
         cells = score0.reshape(v, n_cells)
         rows0 = jnp.arange(v, dtype=jnp.int32)
         waves = max(1, apply_waves)
 
         def cell_pick(ci):
-            i1 = ci // (r_f * k2)
-            i_s = (ci // k2) % r_f
-            i2 = ci % k2
+            i1 = ci // (r_f * k2 * r_f)
+            i_s1 = (ci // (k2 * r_f)) % r_f
+            i2 = (ci // r_f) % k2
+            i_s2 = ci % r_f
             p1 = c1p[rows0, i1]
-            s1 = s1_all[i_s]
-            p2 = c2p[rows0, i2]
-            s2 = c2s[rows0, i2]
-            return p1, s1, p2, s2
+            return p1, s1_all[i_s1], i2, s1_all[i_s2]
 
         def wave(carry, w):
             del w
@@ -784,17 +820,22 @@ def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
             masked = jnp.where(blocked, -jnp.inf, cells)
             ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
             bs = jnp.take_along_axis(masked, ci[:, None], axis=1)[:, 0]
-            p1, s1, p2, s2 = cell_pick(ci)
+            p1, s1, i2, s2 = cell_pick(ci)
             d_i = jnp.maximum(agg_c.assignment[p1, s1], 0)
-            ok_w, improve_w, act1, act2 = validate(
+            p2 = ret_p[d_i, i2]
+            ok_w, improve_w, act1, act2, e_i = validate(
                 static, agg_c, tables, gs, p1, s1, hot, p2, s2, d_i
             )
             ok_w = ok_w & jnp.isfinite(bs)
+            # disjoint over all three brokers; hosts claimed for the two
+            # GAINING endpoints (b only loses when e != b, and when e == b
+            # its host is claimed through e)
             w_sel = wave_select(
                 jnp.where(ok_w, improve_w, -jnp.inf), hot, d_i,
                 static.broker_host[d_i], ok_w, b_count, dims.num_hosts,
-                dst_host2=static.broker_host[hot],
+                dst_host2=static.broker_host[e_i],
                 parts=(p1, p2), num_partitions=p_count,
+                brokers3=e_i,
             )
             agg_c = apply_actions_batch(static, agg_c, act1, w_sel)
             agg_c = apply_actions_batch(static, agg_c, act2, w_sel)
@@ -810,7 +851,7 @@ def make_leadership_swap_round(goal, dims, n_src: int, k_out: int, k_in: int,
         )
         return agg2, applied_any
 
-    return lead_swap_round
+    return relay_round
 
 
 def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,

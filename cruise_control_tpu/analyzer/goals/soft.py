@@ -410,10 +410,12 @@ class LeaderBytesInDistributionGoal(Goal):
     name = "LeaderBytesInDistributionGoal"
     uses_leadership = True
     rotate_drain_candidates = True
-    #: stall fallback: count-neutral leadership exchanges with a similar-load
-    #: return partition (drain.make_leadership_swap_round) — near convergence
-    #: the leader-count bounds veto every +-1 promotion and the usage bands
-    #: veto the full transfer, but a swap's NET transfer passes both
+    #: stall fallback: paired leadership transfers — heavy off the over-
+    #: broker, light off its destination (drain.make_leadership_relay_round).
+    #: Near convergence the leader-count bounds veto every +-1 promotion and
+    #: the usage bands veto the full transfer, but the relay's NET effect
+    #: passes both; the second leg may land anywhere (the pure-swap case is
+    #: the e == b slice of the grid)
     leadership_swap = True
 
     def prepare(self, static, agg, dims):

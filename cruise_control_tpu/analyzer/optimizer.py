@@ -416,14 +416,15 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 settings.drain_dst, settings.apply_waves,
             )
     if getattr(goal, "leadership_swap", False) and dims.max_rf >= 2:
-        from cruise_control_tpu.analyzer.drain import make_leadership_swap_round
+        from cruise_control_tpu.analyzer.drain import make_leadership_relay_round
 
-        # stall fallback for leader-load goals: count-neutral leadership
-        # exchanges whose NET transfer the prior goals' bounds accept where
-        # every single promotion is frozen (runs in greedy parity mode too —
-        # it strictly improves this goal's cost and is a legal action
-        # composition under every previously-optimized goal's bounds)
-        lead_swap_fn = make_leadership_swap_round(
+        # stall fallback for leader-load goals: paired leadership transfers
+        # (heavy off the over-broker, light off its destination) whose NET
+        # effect the prior goals' bounds accept where every single promotion
+        # is frozen (runs in greedy parity mode too — it strictly improves
+        # this goal's cost and is a legal action composition under every
+        # previously-optimized goal's bounds)
+        lead_swap_fn = make_leadership_relay_round(
             goal, dims, settings.drain_src, 4, 8, settings.apply_waves
         )
     if getattr(goal, "uses_swaps", False):
@@ -450,17 +451,21 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     empties_to_stall = 8 if rotated else 1
 
     def goal_loop(static: StaticCtx, agg: Aggregates, tables, budget=None,
-                  rnd_base=None, empties0=None):
+                  rnd_base=None, empties0=None, stall_at=None):
         """Run rounds until convergence or `budget` MORE rounds (dynamic
         scalar; defaults to the static per-goal cap). `rnd_base`/`empties0`
         resume a goal paused at a chunk boundary: the round index seeds the
         pair-drain rotation (restarting it at 0 every device call would
         replay the same surplus slices and never reach the rest), and the
         carried empty-round streak keeps the multi-round stall detection
-        correct across calls. Returns (agg, rounds, empties): `empties >=
-        empties_to_stall` means the goal converged, as opposed to merely
-        running out of budget (the chunked executor's resume signal)."""
+        correct across calls. `stall_at` (traced scalar, default the static
+        empties_to_stall) lets the polish pass buy a cheaper stall proof.
+        Returns (agg, rounds, empties): `empties >= stall_at` means the goal
+        converged, as opposed to merely running out of budget (the chunked
+        executor's resume signal)."""
         gs0 = goal.prepare(static, agg, dims)
+        if stall_at is None:
+            stall_at = jnp.int32(empties_to_stall)
         if budget is None:
             budget = jnp.int32(settings.max_rounds_per_goal)
             if settings.cost_scaled_rounds > 0:
@@ -479,7 +484,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
 
         def cond(c):
             _, rnd, empties = c
-            return (rnd - rnd_base < budget) & (empties < empties_to_stall)
+            return (rnd - rnd_base < budget) & (empties < stall_at)
 
         def body(c):
             agg_c, rnd, empties = c
@@ -574,6 +579,12 @@ class StackMetrics(NamedTuple):
     #: search, which the bench's parity block reports (a cap-bound greedy
     #: baseline compares caps, not search quality)
     converged: jax.Array  # bool[G]
+    #: position-weighted aggregate fingerprint at the goal's exit — the
+    #: polish pass skips a converged goal only when the CLUSTER STATE is
+    #: bit-identical to its exit state (the goal's own cost is too coarse:
+    #: later goals can free acceptance headroom — broker_load, host CPU —
+    #: without touching this goal's metric)
+    state_fp: jax.Array  # f32[G]
 
 
 def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
@@ -593,7 +604,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
 
     def stack_step(static: StaticCtx, agg: Aggregates):
         tables = empty_tables(dims)
-        vb, va, cb, ca, rs, cv = [], [], [], [], [], []
+        vb, va, cb, ca, rs, cv, fps = [], [], [], [], [], [], []
         for goal, loop in zip(goals, loops):
             gs0 = goal.prepare(static, agg, dims)
             vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
@@ -604,6 +615,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
             rs.append(rounds)
             cv.append(empties >= loop.empties_to_stall)
+            fps.append(_state_fingerprint(agg))
             tables = goal.contribute_acceptance(static, gs1, tables)
         if settings.polish_rounds > 0:
             # polish pass under the FULL merged tables (see
@@ -612,18 +624,20 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             # uses the chunked machine, where the polish phases reuse the
             # same traced branches
             for i, (goal, loop) in enumerate(zip(goals, loops)):
-                gs_now = goal.prepare(static, agg, dims)
-                cost_now = goal.cost(static, gs_now, agg).astype(jnp.float32)
-                # retry only when later goals' moves changed this goal's
-                # state after it stalled (mirrors the chunked machine's
-                # skip_polish)
-                skip = cv[i] & (cost_now == ca[i])
+                # retry only when later goals' moves changed the cluster
+                # state after this goal stalled (mirrors the chunked
+                # machine's fingerprint-based skip_polish + halved stall
+                # threshold)
+                skip = cv[i] & (_state_fingerprint(agg) == fps[i])
+                stall_g = jnp.int32(max(1, loop.empties_to_stall // 2))
                 agg, rounds, empties = loop(
                     static, agg, tables,
                     jnp.where(skip, jnp.int32(0), jnp.int32(settings.polish_rounds)),
+                    stall_at=stall_g,
                 )
                 rs[i] = rs[i] + rounds
-                cv[i] = jnp.where(skip, cv[i], empties >= loop.empties_to_stall)
+                cv[i] = jnp.where(skip, cv[i], empties >= stall_g)
+                fps[i] = _state_fingerprint(agg)
             for i, goal in enumerate(goals):
                 gs1 = goal.prepare(static, agg, dims)
                 va[i] = jnp.sum(
@@ -637,6 +651,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             cost_after=jnp.stack(ca),
             rounds=jnp.stack(rs),
             converged=jnp.stack(cv),
+            state_fp=jnp.stack(fps),
         )
         return agg, metrics
 
@@ -746,22 +761,35 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 skip_polish = jnp.asarray(False)
                 if settings.polish_rounds > 0:
                     # a polish retry can only find new actions when LATER
-                    # goals' moves changed this goal's state after it
-                    # stalled (fuller tables only restrict); identical cost
-                    # + a converged main pass => nothing to retry, skip the
-                    # stall-detection rounds (8 empty grid evaluations for
-                    # rotated goals)
+                    # goals' moves changed the CLUSTER STATE after this goal
+                    # stalled (fuller tables only restrict) — compared via
+                    # the position-weighted aggregate fingerprint, NOT the
+                    # goal's own cost: later goals can free acceptance
+                    # headroom (broker_load, host CPU) without touching this
+                    # goal's metric. Identical state + a converged main pass
+                    # => nothing to retry; skip the stall-detection rounds
+                    # (8 empty grid evaluations for rotated goals)
                     skip_polish = (
                         polishing
                         & metrics_b.converged[gim]
-                        & (cost_in == metrics_b.cost_after[gim])
+                        & (_state_fingerprint(agg_b) == metrics_b.state_fp[gim])
                     )
                     cap_g = jnp.where(polishing, jnp.int32(settings.polish_rounds), cap_g)
                     cap_g = jnp.where(skip_polish, jnp.int32(0), cap_g)
                 budget_g = jnp.minimum(left, cap_g - rig)
+                # polish phases buy a cheaper stall proof: half the empty-
+                # round threshold (a second-chance pass need not re-prove
+                # every rotation slice blocked)
+                stall_g = jnp.int32(loop.empties_to_stall)
+                if settings.polish_rounds > 0:
+                    stall_g = jnp.where(
+                        polishing,
+                        jnp.minimum(stall_g, jnp.int32(max(1, loop.empties_to_stall // 2))),
+                        stall_g,
+                    )
                 agg2, rounds, emp2 = loop(
                     static, agg_b, tables_b, budget_g,
-                    rnd_base=rig, empties0=emp,
+                    rnd_base=rig, empties0=emp, stall_at=stall_g,
                 )
                 rig2 = rig + rounds
                 # a skipped polish phase keeps the main pass's converged
@@ -769,7 +797,7 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 stalled = jnp.where(
                     skip_polish,
                     metrics_b.converged[gim],
-                    emp2 >= loop.empties_to_stall,
+                    emp2 >= stall_g,
                 )
                 done_goal = stalled | (rig2 >= cap_g)
                 gs_out = goal.prepare(static, agg2, dims)
@@ -795,6 +823,9 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                         metrics_b.rounds.at[gim].set(rig2),
                     ),
                     converged=metrics_b.converged.at[gim].set(stalled),
+                    state_fp=metrics_b.state_fp.at[gim].set(
+                        _state_fingerprint(agg2)
+                    ),
                 )
                 gi2 = jnp.where(done_goal, gi + 1, gi)
                 rig2 = jnp.where(done_goal, jnp.int32(0), rig2)
@@ -834,7 +865,27 @@ def empty_stack_metrics(n_goals: int) -> StackMetrics:
         cost_after=jnp.zeros((n_goals,), jnp.float32),
         rounds=jnp.zeros((n_goals,), jnp.int32),
         converged=jnp.zeros((n_goals,), bool),
+        state_fp=jnp.zeros((n_goals,), jnp.float32),
     )
+
+
+def _state_fingerprint(agg: Aggregates) -> jax.Array:
+    """f32 scalar: position-weighted sum over the per-broker aggregates.
+
+    Changes whenever load, leadership, or replicas MOVE between brokers
+    (plain totals are move-invariant, so each broker's contribution is
+    weighted by its index). Exact f32 equality is the test: two states
+    compare equal only when no aggregate differs — the polish pass uses this
+    to prove 'nothing changed since this goal exited', so a false negative
+    (collision) is the only risk and requires exactly cancelling weighted
+    deltas across four independent tables."""
+    b = agg.broker_load.shape[0]
+    w = jnp.arange(1, b + 1, dtype=jnp.float32)
+    fp = jnp.vdot(w, jnp.sum(agg.broker_load, axis=-1))
+    fp += jnp.vdot(w, agg.leader_nw_in)
+    fp += jnp.vdot(w, agg.leader_count.astype(jnp.float32))
+    fp += jnp.vdot(w, agg.replica_count.astype(jnp.float32))
+    return fp
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)

@@ -525,8 +525,8 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 )
                 applied = applied | tswap_applied
             if lead_swap_fn is not None:
-                # count-neutral leadership exchanges once plain promotions
-                # and moves stall (drain.make_leadership_swap_round)
+                # paired leadership transfers once plain promotions and
+                # moves stall (drain.make_leadership_relay_round)
                 agg2, lswap_applied = jax.lax.cond(
                     applied,
                     lambda a: (a, jnp.asarray(False)),
@@ -579,12 +579,12 @@ class StackMetrics(NamedTuple):
     #: search, which the bench's parity block reports (a cap-bound greedy
     #: baseline compares caps, not search quality)
     converged: jax.Array  # bool[G]
-    #: position-weighted aggregate fingerprint at the goal's exit — the
-    #: polish pass skips a converged goal only when the CLUSTER STATE is
+    #: position-weighted aggregate bit-pattern hash at the goal's exit —
+    #: the polish pass skips a converged goal only when the CLUSTER STATE is
     #: bit-identical to its exit state (the goal's own cost is too coarse:
     #: later goals can free acceptance headroom — broker_load, host CPU —
     #: without touching this goal's metric)
-    state_fp: jax.Array  # f32[G]
+    state_fp: jax.Array  # u32[G]
 
 
 def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
@@ -865,26 +865,43 @@ def empty_stack_metrics(n_goals: int) -> StackMetrics:
         cost_after=jnp.zeros((n_goals,), jnp.float32),
         rounds=jnp.zeros((n_goals,), jnp.int32),
         converged=jnp.zeros((n_goals,), bool),
-        state_fp=jnp.zeros((n_goals,), jnp.float32),
+        state_fp=jnp.zeros((n_goals,), jnp.uint32),
     )
 
 
 def _state_fingerprint(agg: Aggregates) -> jax.Array:
-    """f32 scalar: position-weighted sum over the per-broker aggregates.
+    """uint32 scalar: position-weighted integer hash of the per-broker
+    aggregates' BIT PATTERNS.
 
     Changes whenever load, leadership, or replicas MOVE between brokers
-    (plain totals are move-invariant, so each broker's contribution is
-    weighted by its index). Exact f32 equality is the test: two states
-    compare equal only when no aggregate differs — the polish pass uses this
-    to prove 'nothing changed since this goal exited', so a false negative
-    (collision) is the only risk and requires exactly cancelling weighted
-    deltas across four independent tables."""
-    b = agg.broker_load.shape[0]
-    w = jnp.arange(1, b + 1, dtype=jnp.float32)
-    fp = jnp.vdot(w, jnp.sum(agg.broker_load, axis=-1))
-    fp += jnp.vdot(w, agg.leader_nw_in)
-    fp += jnp.vdot(w, agg.leader_count.astype(jnp.float32))
-    fp += jnp.vdot(w, agg.replica_count.astype(jnp.float32))
+    (plain totals are move-invariant, so each element is weighted by a
+    position-derived odd multiplier). Hashing the bit patterns, not a float
+    sum: at north-star magnitudes an f32 accumulator's ulp (~2.6e5 at 4e12)
+    silently absorbs exactly the small leadership-count deltas the polish
+    pass must detect. A wrap-around integer hash is exact — any single
+    changed element changes the hash unless a multi-table collision cancels
+    it (~2^-32), and a collision only costs one skipped polish retry."""
+
+    def mix(arr, salt: int):
+        x = jnp.asarray(arr)
+        if jnp.issubdtype(x.dtype, jnp.integer):
+            bits = x.astype(jnp.uint32)
+        else:
+            bits = jax.lax.bitcast_convert_type(
+                x.astype(jnp.float32), jnp.uint32
+            )
+        flat = bits.reshape(-1)
+        w = (
+            jnp.arange(1, flat.shape[0] + 1, dtype=jnp.uint32)
+            * jnp.uint32(2654435761)  # Knuth multiplicative constant
+            + jnp.uint32(salt)
+        )
+        return jnp.sum(flat * w, dtype=jnp.uint32)
+
+    fp = mix(agg.broker_load, 0x9E3779B9)
+    fp += mix(agg.leader_nw_in, 0x85EBCA6B)
+    fp += mix(agg.leader_count, 0xC2B2AE35)
+    fp += mix(agg.replica_count, 0x27D4EB2F)
     return fp
 
 

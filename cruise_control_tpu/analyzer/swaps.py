@@ -54,8 +54,9 @@ def _slot_contrib(static: StaticCtx, assignment: jax.Array, res: int) -> jax.Arr
 
 def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
                     swaps_per_broker: int = 4, apply_waves: int = 0):
-    """Build swap_round(static, agg, tables, runs) -> (agg, applied_any) for
-    a resource-distribution goal (jit-compatible; call inside the goal loop).
+    """Build swap_round(static, agg, tables, contrib_in) -> (agg, applied_any)
+    for a resource-distribution goal (jit-compatible; call inside the goal
+    loop).
 
     `tables` are the merged acceptance bounds of the already-optimized goals
     (analyzer.acceptance): every candidate swap's NET effect must pass them,

@@ -124,3 +124,67 @@ def test_kafka_assigner_even_distribution():
     avg = counts.mean()
     assert counts.max() <= np.ceil(avg) + 1
     assert counts.min() >= np.floor(avg) - 1
+
+
+def test_leadership_relay_fixes_count_frozen_state():
+    """The leadership-RELAY deadlock (drain.make_leadership_relay_round):
+    every single promotion off the over-bound broker is vetoed — b1 sits AT
+    its leader-count cap so promoting INTO it fails, and promoting b1's own
+    leader away is improvement-neutral — but the compound relay (heavy p0
+    leadership b0 -> b1 paired with light p2 leadership b1 -> b2) is
+    count-neutral at b1 and strictly improves the leader-bytes-in spread."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.acceptance import empty_tables
+    from cruise_control_tpu.analyzer.context import (
+        build_static_ctx,
+        compute_aggregates,
+        dims_of,
+    )
+    from cruise_control_tpu.analyzer.drain import make_leadership_relay_round
+    from cruise_control_tpu.config.balancing import BalancingConstraint
+
+    # p0=[b0,b1] w6, p1=[b0,b1] w4, p2=[b1,b2] w5 -> leader NW_IN per broker
+    # [10, 5, 0], leader counts [2, 1, 0]
+    assignment = np.array([[0, 1], [0, 1], [1, 2]], dtype=np.int32)
+    topic_id = np.array([0, 1, 2], dtype=np.int32)
+    load = _part_load(
+        cpu_leader=[1.0, 1.0, 1.0],
+        nw_in_leader=[6.0, 4.0, 5.0],
+        nw_out_leader=[1.0, 1.0, 1.0],
+        disk=[1.0e4, 1.0e4, 1.0e4],
+    )
+    cap = _uniform_capacity(3, disk=1.0e6)
+    rack = np.array([0, 1, 2], dtype=np.int32)
+    m = make_model(assignment, load, topic_id, cap, rack)
+
+    dims = dims_of(m)
+    static = build_static_ctx(m, BalancingConstraint.default(), dims)
+    agg = compute_aggregates(static, jnp.asarray(m.assignment), dims)
+    goal = GOAL_REGISTRY["LeaderBytesInDistributionGoal"]
+    gs = goal.prepare(static, agg, dims)
+    assert float(gs.upper) < 10.0, "fixture must leave b0 over the window"
+
+    # prior-goal tables: leader-count caps at the CURRENT counts — any
+    # single promotion into b1 busts its cap; the relay keeps b1 neutral
+    tables = empty_tables(dims)._replace(
+        hi_lead=jnp.asarray([2.0, 1.0, 1.0], dtype=jnp.float32)
+    )
+    relay = make_leadership_relay_round(
+        goal, dims, n_src=3, k_out=2, k_ret=2, apply_waves=2
+    )
+    agg2, applied = relay(static, agg, tables, gs, jnp.int32(0))
+    assert bool(applied), "relay must find the compound action"
+    a2 = np.asarray(agg2.assignment)
+    # the p0 (w6) and p1 (w4) relays tie on improvement (both land every
+    # broker within 0.5 of the window); excess-targeted ranking may pick
+    # either — both are legal and count-neutral at b1
+    relayed_p0 = a2[0, 0] == 1 and a2[1, 0] == 0
+    relayed_p1 = a2[1, 0] == 1 and a2[0, 0] == 0
+    assert relayed_p0 or relayed_p1, "exactly one heavy leader must relay b0 -> b1"
+    assert a2[2, 0] == 2, "p2 leadership must relay b1 -> b2"
+    lnw = np.asarray(agg2.leader_nw_in)
+    expect = [4.0, 6.0, 5.0] if relayed_p0 else [6.0, 4.0, 5.0]
+    assert lnw == pytest.approx(expect)
+    counts = np.asarray(agg2.leader_count)
+    assert counts.tolist() == [1, 1, 1]

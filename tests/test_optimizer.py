@@ -283,3 +283,33 @@ class TestOptions:
         )
         for pr in result.proposals:
             assert set(pr.replicas_to_add) <= {3}
+
+
+def test_state_fingerprint_detects_single_leadership_flip():
+    """The polish-skip fingerprint must detect ANY inter-broker movement —
+    including a lone leadership flip, whose weighted f32 delta was below the
+    accumulator ulp at north-star magnitudes before the bit-pattern hash
+    (review round 5): identical states hash equal, one flipped leader
+    hashes different."""
+    from cruise_control_tpu.analyzer.optimizer import _state_fingerprint
+
+    model = generators.random_cluster(
+        seed=5,
+        prop=generators.ClusterProperty(
+            num_racks=3, num_brokers=8, num_topics=10,
+            mean_partitions_per_topic=6.0, replication_factor=2,
+        ),
+    )
+    dims = dims_of(model)
+    static = build_static_ctx(model, BalancingConstraint.default(), dims)
+    a = np.asarray(model.assignment)
+    agg = compute_aggregates(static, a, dims)
+    agg_same = compute_aggregates(static, a.copy(), dims)
+    flipped = a.copy()
+    row = next(i for i in range(flipped.shape[0]) if flipped[i, 1] >= 0)
+    flipped[row, 0], flipped[row, 1] = flipped[row, 1], flipped[row, 0]
+    agg_flip = compute_aggregates(static, flipped, dims)
+
+    fp = int(_state_fingerprint(agg))
+    assert fp == int(_state_fingerprint(agg_same))
+    assert fp != int(_state_fingerprint(agg_flip))

@@ -62,7 +62,14 @@ def pytest_collection_modifyitems(config, items):
     """Opt-in slow lane: every XLA compile on this 1-core box costs tens of
     seconds, so cases that only widen coverage already held by a sibling
     (e.g. one single-goal program per goal when one per goal FAMILY already
-    compiles the same kernels) are deselected unless --runslow is given."""
+    compiles the same kernels) are deselected unless --runslow is given.
+
+    Fast-lane wall-clock (round 5: ~13 min; --runslow ~20 min) is
+    compile-bound: ~10 distinct (goal set, dims, settings) stack programs at
+    40-60 s XLA:CPU compile each on one core. The remaining programs are
+    each primary coverage (default stack, chunked machine, polish pass,
+    faithful greedy, mesh equivalence, per-kernel-family single goals);
+    shrinking the wall further means dropping one of those, not tuning."""
     if config.getoption("--runslow"):
         return
     skip = pytest.mark.skip(reason="slow lane: pass --runslow to include")

@@ -57,7 +57,7 @@ BENCH_PROBE_TIMEOUT_S, BENCH_PROBE_RETRIES (default 3), BENCH_REPROBE=0 to
 disable mid-run re-probing, BENCH_STAGES (comma list, default "1,2,3,4,5"),
 BENCH_PARITY=0 to skip the greedy passes, BENCH_PARITY5_BROKERS (parity
 model size for config 5, default 520), BENCH_GREEDY_CEILING (greedy
-cost-scaled round-cap ceiling, default 8192), BENCH_POLISH_ROUNDS (batched
+cost-scaled round-cap ceiling, default 4096), BENCH_POLISH_ROUNDS (batched
 full-table polish pass budget per goal, default 48; 0 disables).
 """
 
@@ -149,7 +149,12 @@ def _settings(batched: bool):
     # scales with each goal's entry cost (one action ~ one cost unit at
     # batch_k=1) so large goals CONVERGE instead of comparing caps; goals the
     # ceiling still binds are reported as greedyCapBoundGoals.
-    ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "8192"))
+    # ceiling 4096: at the 520B parity scale the topic goal needs ~14k
+    # single actions, so NO affordable ceiling converges it — it is
+    # cap-bound (and reported as such) at 4096 exactly as at 8192, while
+    # every other goal's cost-scaled cap converges well below; the smaller
+    # default halves the greedy wall (~660 s -> ~370 s on one CPU core)
+    ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "4096"))
     return OptimizerSettings(batch_k=1, max_rounds_per_goal=512, num_dst_candidates=16,
                              num_swap_pairs=16, swap_candidates=16, swaps_per_broker=4,
                              chunk_rounds=chunk * 4 if chunk else 0,

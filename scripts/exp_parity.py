@@ -40,7 +40,7 @@ batched_s = OptimizerSettings(batch_k=1024, max_rounds_per_goal=128,
                               num_dst_candidates=16, num_swap_pairs=16,
                               swap_candidates=16, swaps_per_broker=4,
                               chunk_rounds=chunk, polish_rounds=polish)
-ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "8192"))
+ceiling = int(os.environ.get("BENCH_GREEDY_CEILING", "4096"))
 greedy_s = OptimizerSettings(batch_k=1, max_rounds_per_goal=512,
                              num_dst_candidates=16, num_swap_pairs=16,
                              swap_candidates=16, swaps_per_broker=4,

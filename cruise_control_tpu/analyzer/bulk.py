@@ -1,0 +1,208 @@
+"""Bulk count-rebalance planner: the surplus/deficit wave kernel for
+count-distribution goals.
+
+The count-family goals (ReplicaDistribution, LeaderReplicaDistribution,
+ReplicaCapacity, LeaderBytesIn's leadership phase — and
+TopicReplicaDistribution through its pair-drain engine) move ~one unit of
+goal cost per action, so a round-by-round greedy spends thousands of serial
+rounds applying moves a closed-form target already determines: every broker's
+distance to the floor/ceil balance window is known up front (the
+assignment-problem view of count balancing — "On Efficiently Partitioning a
+Topic in Apache Kafka", arxiv 2205.09415 — rather than an iterative search).
+This kernel computes per-broker surplus/deficit against those targets in one
+vectorized pass and emits the whole move set in conflict-free waves:
+
+  1. surplus/deficit: `goal.bulk_counts` -> units each broker must shed
+     (dead brokers: everything — evacuation precedes balance) and a
+     deficit-first destination rank key;
+  2. candidates: each surplus broker's top-K drain replicas by the goal's
+     own drain priority (the shared sort-free segment passes,
+     drain.broker_top_replicas);
+  3. matching: the i-th surplus broker pairs with the (i + wave)-th-ranked
+     deficit destination (context.rank_paired_destinations — the
+     sorted-by-sorted matching; rotation retries failed pairs on later
+     waves), plus, for leadership goals, each candidate's R-1 promotion
+     cells whose destinations are fixed by the assignment;
+  4. waves: every nomination is scored EXACTLY (structural legality + merged
+     prior-goal tables + this goal's acceptance and improvement criterion),
+     a broker/host/partition-disjoint subset applies at once
+     (context.wave_select contract), and applied candidates retire.
+
+The schedule is adaptive at every level, so the planner only pays off where
+it wins and hands off where it can't:
+
+  - the whole round is SKIPPED when no broker owes a full unit (the
+    per-round engines' precision-tail regime);
+  - the wave budget per round is ceil(max per-broker surplus), capped;
+  - waves continue only while they deliver bulk-scale progress (at least
+    1/8 of the surplus set per wave) — a dribbling wave means the remaining
+    surplus is blocked-pair precision work, which the per-round engines'
+    richer candidate sets handle at the same per-action cost.
+
+Every applied action is individually legal and improving at application
+time, so a bulk round composes exactly like a sequence of reference-legal
+greedy steps (AbstractGoal.java:67-101): the one-action-at-a-time acceptance
+semantics of the reference are preserved — only the search order changes.
+The per-round engines (the exhaustive [P, R, K] grid in greedy parity mode,
+the drain/fill rounds in batched mode) remain as the precision tail: the
+goal loop falls back to them whenever the planner finds nothing, so the
+final converged state is at least as good as without the planner.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from cruise_control_tpu.analyzer.actions import (
+    KIND_LEADERSHIP,
+    KIND_MOVE,
+    build_selected,
+)
+from cruise_control_tpu.analyzer.acceptance import score_batch
+from cruise_control_tpu.analyzer.context import (
+    Aggregates,
+    StaticCtx,
+    apply_actions_batch,
+    rank_paired_destinations,
+    replicas_on_dead,
+    wave_select,
+)
+from cruise_control_tpu.analyzer.drain import broker_top_replicas
+
+
+def make_bulk_count_round(goal, dims, k_cand: int, max_waves: int):
+    """Build bulk_round(static, agg, tables, gs, contrib, rnd) ->
+    (agg2, applied) for a count-family goal (goal.count_family).
+
+    `contrib` is the goal's drain_contrib for the entry aggregates (shared
+    with the drain/swap engines); candidate picks are fixed at round start
+    and re-validated every wave, with applied candidates retired so later
+    waves consume the next ones. `rnd` offsets the destination rotation so
+    consecutive rounds retry blocked pairs against different destinations.
+    """
+    p_count, r = dims.num_partitions, dims.max_rf
+    b_count = dims.num_brokers
+    k = max(1, min(k_cand, p_count))
+    use_leadership = goal.uses_leadership and r >= 2
+    # cells per candidate: the paired move plus, for leadership goals, one
+    # promotion per follower slot (whose destination the assignment fixes)
+    fam = r if use_leadership else 1
+
+    def bulk_round(static: StaticCtx, agg: Aggregates, tables, gs, contrib,
+                   rnd=jnp.int32(0)):
+        # adaptive wave budget: each wave sheds at most one unit per surplus
+        # broker (wave disjointness), so ceil(max surplus) waves suffice
+        # under perfect matching
+        c0 = goal.bulk_counts(static, gs, agg)
+        waves_dyn = jnp.clip(
+            jnp.ceil(jnp.max(c0.surplus)).astype(jnp.int32), 1, max_waves
+        )
+        rows = jnp.arange(b_count, dtype=jnp.int32)
+
+        def run(agg_in):
+            # every replica on a dead broker is a candidate regardless of
+            # the goal's own priorities
+            # (GoalUtils.ensureNoReplicaOnDeadBrokers)
+            contrib_r = jnp.where(
+                replicas_on_dead(static, agg_in.assignment),
+                jnp.float32(1e9), contrib,
+            )
+            cand_p, cand_s, cand_ok = broker_top_replicas(
+                static, agg_in, contrib_r, k, b_count
+            )  # [B, K]
+
+            def cond(c):
+                _, _, w, go, _ = c
+                return go & (w < waves_dyn)
+
+            def body(c):
+                agg_c, applied_any, w, _, done = c
+                counts = goal.bulk_counts(static, gs, agg_c)
+                valid_src = counts.surplus > 0.0
+                n_valid = jnp.sum(valid_src.astype(jnp.int32))
+                paired = rank_paired_destinations(
+                    valid_src, counts.dst_key, w + rnd
+                )
+                a = agg_c.assignment
+                live = cand_ok & ~done & valid_src[:, None]
+                mv = build_selected(
+                    static.part_load, a, cand_p, jnp.int32(KIND_MOVE),
+                    cand_s, paired[:, None],
+                )
+                s_mv = jnp.where(
+                    live, score_batch(static, agg_c, mv, goal, gs, tables),
+                    -jnp.inf,
+                )  # [B, K]
+                if use_leadership:
+                    slots = jnp.arange(1, r, dtype=jnp.int32)[None, None, :]
+                    p3 = cand_p[:, :, None]
+                    ld = build_selected(
+                        static.part_load, a, p3, jnp.int32(KIND_LEADERSHIP),
+                        slots, a[p3, slots],
+                    )
+                    s_ld = jnp.where(
+                        live[:, :, None],
+                        score_batch(static, agg_c, ld, goal, gs, tables),
+                        -jnp.inf,
+                    )  # [B, K, R-1]
+                    cells = jnp.concatenate([s_mv[:, :, None], s_ld], axis=2)
+                    cells = cells.reshape(b_count, k * fam)
+                else:
+                    cells = s_mv
+                # one nomination per source broker: its best cell
+                j = jnp.argmax(cells, axis=1).astype(jnp.int32)
+                best = jnp.take_along_axis(cells, j[:, None], axis=1)[:, 0]
+                k_i = j // fam
+                f_i = j % fam
+                p_i = cand_p[rows, k_i]
+                s_i = jnp.where(f_i == 0, cand_s[rows, k_i], f_i)
+                kind_i = jnp.where(
+                    f_i == 0, jnp.int32(KIND_MOVE), jnp.int32(KIND_LEADERSHIP)
+                )
+                dst_i = jnp.where(f_i == 0, paired, a[p_i, jnp.maximum(f_i, 0)])
+                act = build_selected(
+                    static.part_load, a, p_i, kind_i, s_i, dst_i
+                )
+                w_sel = wave_select(
+                    best, act.src, act.dst, static.broker_host[act.dst],
+                    jnp.isfinite(best), b_count, dims.num_hosts,
+                    parts=(act.p,), num_partitions=p_count,
+                )
+                agg_c = apply_actions_batch(static, agg_c, act, w_sel)
+                # an applied row's candidate left its source (or its
+                # leadership moved): retire it so later waves consume the
+                # next candidate
+                done = done.at[rows, k_i].set(done[rows, k_i] | w_sel)
+                n_applied = jnp.sum(w_sel.astype(jnp.int32))
+                # adaptive handoff: continue only while waves deliver
+                # BULK-scale progress (>= 1/8 of the surplus set). A
+                # dribbling wave means the remaining surplus is a precision
+                # problem — blocked pairs, rare legal destinations — which
+                # the per-round engine's richer candidate sets handle at
+                # the same per-action cost; burning the full wave budget on
+                # it stacked planner cost on engine cost without reducing
+                # rounds (measured +22% on the 2,600-broker bench before
+                # this gate).
+                go = n_applied >= jnp.maximum(1, n_valid // 8)
+                return (agg_c, applied_any | (n_applied > 0), w + 1, go, done)
+
+            init = (
+                agg_in, jnp.asarray(False), jnp.int32(0), jnp.asarray(True),
+                jnp.zeros((b_count, k), dtype=bool),
+            )
+            agg2, applied_any, _, _, _ = jax.lax.while_loop(cond, body, init)
+            return agg2, applied_any
+
+        # no broker owes a whole unit (and dead brokers, whose surplus is
+        # their full holding, are empty): the remaining work is the
+        # per-round engines' precision tail — skip the planner's fixed
+        # per-round cost (candidate segment passes + one probe wave)
+        return jax.lax.cond(
+            jnp.max(c0.surplus) >= 1.0,
+            run,
+            lambda a: (a, jnp.asarray(False)),
+            agg,
+        )
+
+    return bulk_round

@@ -453,6 +453,29 @@ def wave_select(score, src, dst, dst_host, valid, num_brokers: int, num_hosts: i
     return sel
 
 
+def rank_paired_destinations(valid_src, dst_key, offset) -> jax.Array:
+    """i32[B]: pair the i-th valid source broker (by broker id) with the
+    (i + offset)-th-best destination by `dst_key`, wrapping over the feasible
+    prefix.
+
+    The sorted-by-sorted matching the optimizer's shortlist waves use,
+    generalized to broker-wide source sets (the bulk count planner,
+    analyzer.bulk): a per-source argmax would send every source to the same
+    best destination, and the waves' broker-disjointness would then admit ONE
+    action per wave. Rank pairing keeps the whole surplus set moving in
+    parallel; rotating `offset` across waves retries failed pairs against
+    different destinations, and exact re-validation drops any mispair.
+    `dst_key`: higher = better, -inf = ineligible (an all-ineligible key
+    degrades to broker rank[0] and every nomination fails validation).
+    """
+    rank = jnp.argsort(-dst_key).astype(jnp.int32)
+    n_feasible = jnp.maximum(
+        jnp.sum(jnp.isfinite(dst_key)).astype(jnp.int32), 1
+    )
+    rr = jnp.cumsum(valid_src.astype(jnp.int32)) - 1
+    return rank[(rr + offset) % n_feasible]
+
+
 def apply_actions_batch(
     static: StaticCtx, agg: Aggregates, act: ActionBatch, flags: jax.Array
 ) -> Aggregates:

@@ -18,7 +18,7 @@ All methods must be jittable and shape-polymorphic over the action batch.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -34,6 +34,20 @@ BALANCE_MARGIN = 0.9
 
 #: Minimum action score considered a real improvement (float32 noise floor).
 SCORE_EPS = 1e-6
+
+
+class BulkCounts(NamedTuple):
+    """Per-broker surplus/destination snapshot for the bulk count planner
+    (analyzer.bulk).
+
+    `surplus` is denominated in approximate MOVE UNITS (replica or
+    leadership transfers) so the planner's adaptive wave budget —
+    ceil(max surplus) waves — is meaningful for byte-valued goals too;
+    `dst_key` only orders destinations (deficit brokers first, then
+    headroom), exact validation decides legality."""
+
+    surplus: jax.Array  #: f32[B] units each broker must shed; dead: everything
+    dst_key: jax.Array  #: f32[B] destination rank (higher = better; -inf = ineligible)
 
 
 class Goal:
@@ -55,6 +69,15 @@ class Goal:
     #: multi-round stall patience (one empty round only proves one rotation
     #: slice is blocked).
     rotate_drain_candidates: bool = False
+    #: count-family goal: the goal's targets are floor/ceil balance windows
+    #: over integer-ish per-broker quantities, moved ~one unit per action.
+    #: The bulk count planner (analyzer.bulk) drains the whole
+    #: surplus/deficit grid per round via `bulk_counts` — except pair_drain
+    #: goals (TopicReplicaDistributionGoal), whose (topic, broker) pair
+    #: rounds (analyzer.drain.make_pair_drain_round) already ARE the
+    #: per-topic×broker form of the same surplus/deficit kernel and run in
+    #: every mode when the planner is enabled.
+    count_family: bool = False
 
     def prepare(self, static: StaticCtx, agg: Aggregates, dims) -> Any:
         """Per-goal threshold state derived from current aggregates."""
@@ -96,6 +119,14 @@ class Goal:
     # which brokers to drain, which replicas to drain first, and where to
     # send them. Validation stays exact (acceptance/action_score), so the
     # hooks only shape the candidate set, never the semantics.
+
+    def bulk_counts(self, static: StaticCtx, gs, agg: Aggregates) -> BulkCounts:
+        """Count-family goals only (count_family=True, pair_drain=False):
+        per-broker units to shed against the floor/ceil balance targets and
+        a deficit-first destination key for the bulk count planner
+        (analyzer.bulk). Dead brokers must report their entire holding as
+        surplus — evacuation precedes balance."""
+        raise NotImplementedError
 
     def src_rank(self, static: StaticCtx, gs, agg: Aggregates) -> jax.Array:
         """f32[B]: source priority for the drain round (-inf = not a source).

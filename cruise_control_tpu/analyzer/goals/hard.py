@@ -19,7 +19,7 @@ import jax.numpy as jnp
 
 from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
 from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, utilization
-from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
+from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, BulkCounts, Goal
 from cruise_control_tpu.common.resources import Resource
 
 
@@ -96,6 +96,10 @@ class ReplicaCapacityGoal(Goal):
     name = "ReplicaCapacityGoal"
     is_hard = True
     uses_moves = True
+    #: count-family: surplus over the hard cap drains through the bulk
+    #: planner's waves (one unit off every over broker per wave) instead of
+    #: round-by-round — the same kernel the distribution count goals use
+    count_family = True
 
     def broker_violation(self, static, gs, agg):
         return (agg.replica_count > static.max_replicas_per_broker) & static.alive
@@ -131,6 +135,16 @@ class ReplicaCapacityGoal(Goal):
 
         disk = static.part_load[:, PartMetric.DISK]
         return jnp.broadcast_to(-disk[:, None], agg.assignment.shape)
+
+    def bulk_counts(self, static, gs, agg):
+        c = agg.replica_count.astype(jnp.float32)
+        cap = static.max_replicas_per_broker.astype(jnp.float32)
+        surplus = jnp.where(static.dead, c, jnp.maximum(0.0, c - cap))
+        headroom = cap - c
+        dst_key = jnp.where(
+            static.replica_dst_ok & (headroom > 0.0), headroom, -jnp.inf
+        )
+        return BulkCounts(surplus=surplus, dst_key=dst_key)
 
     def contribute_acceptance(self, static, gs, tables):
         cap = static.max_replicas_per_broker.astype(jnp.float32)

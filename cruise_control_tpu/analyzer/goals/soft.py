@@ -26,6 +26,7 @@ from cruise_control_tpu.analyzer.actions import KIND_MOVE, ActionBatch
 from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx, utilization
 from cruise_control_tpu.analyzer.goals.base import (
     SCORE_EPS,
+    BulkCounts,
     Goal,
     balance_limits,
     distribution_score,
@@ -153,6 +154,7 @@ class ReplicaDistributionGoal(Goal):
     ReplicaDistributionAbstractGoal.java:27)."""
 
     name = "ReplicaDistributionGoal"
+    count_family = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
@@ -201,6 +203,17 @@ class ReplicaDistributionGoal(Goal):
         disk = static.part_load[:, PartMetric.DISK]
         return jnp.broadcast_to(-disk[:, None], agg.assignment.shape)
 
+    def bulk_counts(self, static, gs, agg):
+        c = agg.replica_count.astype(jnp.float32)
+        surplus = jnp.where(static.dead, c, jnp.maximum(0.0, c - gs.upper))
+        deficit = jnp.maximum(0.0, gs.lower - c)
+        headroom = gs.upper - c
+        dst_key = jnp.where(
+            static.replica_dst_ok & (headroom > 0.0),
+            deficit * 1e3 + headroom, -jnp.inf,
+        )
+        return BulkCounts(surplus=surplus, dst_key=dst_key)
+
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(
             hi_rep=jnp.minimum(tables.hi_rep, gs.upper),
@@ -215,6 +228,7 @@ class LeaderReplicaDistributionGoal(Goal):
     name = "LeaderReplicaDistributionGoal"
     uses_leadership = True
     rotate_drain_candidates = True
+    count_family = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
@@ -267,6 +281,19 @@ class LeaderReplicaDistributionGoal(Goal):
         is_leader = (jnp.arange(r) == 0)[None, :]
         return jnp.where(is_leader, 1.0 - 1e-9 * disk[:, None], -jnp.inf)
 
+    def bulk_counts(self, static, gs, agg):
+        c = agg.leader_count.astype(jnp.float32)
+        surplus = jnp.where(static.dead, c, jnp.maximum(0.0, c - gs.upper))
+        deficit = jnp.maximum(0.0, gs.lower - c)
+        headroom = gs.upper - c
+        # moves relocate a whole leader replica; promotions (the dominant
+        # family) have assignment-fixed destinations that bypass this key
+        dst_key = jnp.where(
+            static.replica_dst_ok & static.leadership_dst_ok & (headroom > 0.0),
+            deficit * 1e3 + headroom, -jnp.inf,
+        )
+        return BulkCounts(surplus=surplus, dst_key=dst_key)
+
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(
             hi_lead=jnp.minimum(tables.hi_lead, gs.upper),
@@ -284,13 +311,20 @@ class TopicReplicaDistributionGoal(Goal):
     (cc/analyzer/goals/TopicReplicaDistributionGoal.java:53)."""
 
     name = "TopicReplicaDistributionGoal"
-    #: batched engine: drain (topic, broker) surplus pairs
+    #: drain (topic, broker) surplus pairs
     #: (analyzer.drain.make_pair_drain_round) with round-rotated, band-aware
     #: destination lists, plus a similar-load SWAP fallback when moves are
     #: frozen by the prior goals' bands — per-broker replica picks starve
     #: this goal (a broker's top candidates are mostly replicas of the same
     #: over topic)
     pair_drain = True
+    #: the pair rounds are the per-topic×broker form of the bulk count
+    #: planner's surplus/deficit kernel; count_family makes them run in
+    #: greedy parity mode too (the round-by-round [P, R, K] grid needs ~one
+    #: round per unit of topic surplus — ~14k rounds at the 520-broker
+    #: parity scale — while a pair round drains one unit off EVERY surplus
+    #: broker per wave)
+    count_family = True
 
     def prepare(self, static, agg, dims):
         n_alive = jnp.maximum(jnp.sum(static.alive.astype(jnp.float32)), 1.0)
@@ -410,6 +444,10 @@ class LeaderBytesInDistributionGoal(Goal):
     name = "LeaderBytesInDistributionGoal"
     uses_leadership = True
     rotate_drain_candidates = True
+    #: count-like leadership phase: surplus is the broker's excess leader
+    #: bytes-in, normalized to approximate leadership-transfer units by the
+    #: mean leader weight so the bulk planner's wave budget is meaningful
+    count_family = True
     #: stall fallback: paired leadership transfers — heavy off the over-
     #: broker, light off its destination (drain.make_leadership_relay_round).
     #: Near convergence the leader-count bounds veto every +-1 promotion and
@@ -465,6 +503,26 @@ class LeaderBytesInDistributionGoal(Goal):
         r = agg.assignment.shape[1]
         is_leader = (jnp.arange(r) == 0)[None, :]
         return jnp.where(is_leader, nw_in[:, None], -jnp.inf)
+
+    def bulk_counts(self, static, gs, agg):
+        from cruise_control_tpu.common.resources import PartMetric
+
+        lnw = agg.leader_nw_in
+        p_count = static.part_load.shape[0]
+        mean_w = jnp.sum(static.part_load[:, PartMetric.NW_IN_LEADER]) / jnp.maximum(
+            1.0, jnp.float32(p_count)
+        )
+        unit = jnp.maximum(mean_w, 1e-6)
+        surplus = jnp.where(
+            static.dead,
+            agg.leader_count.astype(jnp.float32),
+            jnp.maximum(0.0, lnw - gs.upper) / unit,
+        )
+        headroom = gs.upper - lnw
+        dst_key = jnp.where(
+            static.leadership_dst_ok & (headroom > 0.0), headroom, -jnp.inf
+        )
+        return BulkCounts(surplus=surplus, dst_key=dst_key)
 
     def contribute_acceptance(self, static, gs, tables):
         return tables._replace(

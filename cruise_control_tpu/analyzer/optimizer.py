@@ -22,6 +22,14 @@ actionAcceptance, then mutate the model (:186-227) — becomes, per round:
 With batch_k=1 this degrades to a faithful greedy (the parity mode used by the
 benchmark harness).
 
+Count-family goals short-circuit the per-round search wherever it would be
+round-by-round: the bulk count-rebalance planner (analyzer.bulk) drains the
+whole per-broker surplus/deficit grid in conflict-free waves each round —
+every wave action individually validated at application time, so the result
+is still a sequence of reference-legal greedy steps — and the per-round
+engines above only run when the planner finds nothing (the precision tail).
+See OptimizerSettings.bulk_waves / bulk_min_brokers.
+
 The ENTIRE goal stack runs as ONE jitted XLA program: the priority loop over
 goals is unrolled at trace time (the goal sequence is static), each goal's
 while_loop body follows the previous goal's, and the per-goal before/after
@@ -145,6 +153,32 @@ class OptimizerSettings:
     drain_src: int = 512
     drain_per_broker: int = 8
     drain_dst: int = 64
+    #: > 0: count-family goals (goals.base.Goal.count_family) run the bulk
+    #: count-rebalance planner (analyzer.bulk) FIRST each round — per-broker
+    #: surplus/deficit against the floor/ceil targets as one vectorized
+    #: kernel, matched surplus->deficit in up to this many conflict-free
+    #: waves — in BOTH engines; the per-round engine runs whenever the
+    #: planner finds nothing (the precision tail). In the batch_k=1 greedy
+    #: the planner collapses one-unit rounds 10-20x; in the batched engine
+    #: it also steers the leader goals around band-frozen end states their
+    #: drain path stalls in (path dependence measured at the 520-broker
+    #: parity scale: engine-first leaves leader-count cost 7 that no
+    #: fallback can move, planner-first converges to 0). The schedule is
+    #: adaptive: the planner skips entirely when no broker owes a whole
+    #: unit, its wave budget per round is ceil(max per-broker surplus)
+    #: capped here, and waves continue only while they deliver bulk-scale
+    #: progress — so early rounds drain cost in bulk and the final polish
+    #: rounds cost one probe. Every emitted action is exactly validated at
+    #: application time (one-action-at-a-time acceptance semantics
+    #: preserved). 0 = disable (round-by-round only).
+    bulk_waves: int = 16
+    #: planner size floor: below this many brokers the per-round engines
+    #: already nominate every broker each round (drain_src covers the whole
+    #: cluster, and a small greedy converges in a handful of rounds), so the
+    #: planner would only add compile weight — every compiled stack program
+    #: carries each count goal's bulk kernel. All bench scales (100+ brokers)
+    #: sit above the default; unit tests lower it to exercise the planner.
+    bulk_min_brokers: int = 32
     #: > 0: after the priority stack completes, re-traverse every goal once
     #: more — up to this many rounds each — under the FULL merged acceptance
     #: tables (all goals' bounds, not just the priority prefix). The first
@@ -173,6 +207,9 @@ class OptimizerSettings:
             drain_src=config.get_int("optimizer.drain.source.brokers"),
             drain_per_broker=config.get_int("optimizer.drain.candidates.per.broker"),
             drain_dst=config.get_int("optimizer.drain.destination.brokers"),
+            bulk_waves=config.get_int("optimizer.bulk.count.waves"),
+            bulk_min_brokers=config.get_int("optimizer.bulk.min.brokers"),
+            polish_rounds=config.get_int("optimizer.polish.rounds"),
         )
 
 
@@ -386,8 +423,40 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     # full-destination precision wave for non-swap goals — the
     # stronger-than-reference baseline — while resource-distribution goals
     # use the same drain kernel in both modes (run to deeper convergence in
-    # greedy mode), as the bench always has.
-    use_drain = settings.batch_k > 1 or getattr(goal, "uses_swaps", False)
+    # greedy mode), as the bench always has. Count-family goals additionally
+    # run the bulk count-rebalance planner (analyzer.bulk) FIRST each round
+    # in both modes: the per-round engines only execute when the planner
+    # finds nothing (the precision tail), so the final converged state is at
+    # least as strong while thousands of one-unit rounds collapse into tens
+    # of conflict-free waves. TopicReplicaDistributionGoal's pair-drain
+    # rounds ARE its bulk kernel (per-topic×broker surplus/deficit), so
+    # count_family routes it through the drain engine in greedy mode too.
+    use_bulk = (
+        settings.bulk_waves > 0
+        and dims.num_brokers >= settings.bulk_min_brokers
+        and getattr(goal, "count_family", False)
+    )
+    use_drain = (
+        settings.batch_k > 1
+        or getattr(goal, "uses_swaps", False)
+        or (use_bulk and getattr(goal, "pair_drain", False))
+    )
+    bulk_fn = None
+    # The planner leads EVERY round for every (non-pair) count goal, in both
+    # engines. Ordering is quality-relevant, not just speed-relevant: the
+    # leader goals' end states are path-dependent (engine-first at the
+    # 520-broker parity scale stalls at leader-count cost 7 in a state so
+    # band-frozen that no engine fallback OR planner probe can move it,
+    # while planner-first never enters that state and converges to 0 — the
+    # parity gate's margin). The planner's adaptive gates (analyzer.bulk:
+    # whole-unit skip, bulk-progress wave handoff) keep its cost near zero
+    # outside its regime.
+    if use_bulk and not getattr(goal, "pair_drain", False):
+        from cruise_control_tpu.analyzer.bulk import make_bulk_count_round
+
+        bulk_fn = make_bulk_count_round(
+            goal, dims, settings.drain_per_broker, settings.bulk_waves
+        )
     drain_fn = None
     swap_fn = None
     topic_swap_fn = None
@@ -469,10 +538,23 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         if budget is None:
             budget = jnp.int32(settings.max_rounds_per_goal)
             if settings.cost_scaled_rounds > 0:
+                scale = goal.cost(static, gs0, agg)
+                if use_bulk:
+                    # adaptive batch schedule: a bulk round drains about one
+                    # unit off EVERY violated broker per wave, so the
+                    # cost-scaled cap normalizes by the entry violated set
+                    # instead of assuming one unit per round; the
+                    # max_rounds_per_goal floor keeps the precision tail
+                    scale = scale / jnp.maximum(
+                        1.0,
+                        jnp.sum(
+                            goal.broker_violation(static, gs0, agg)
+                        ).astype(jnp.float32),
+                    )
                 # clip in FLOAT before the int cast: byte-denominated goal
                 # costs overflow int32 and would wrap the cap back down
                 scaled = jnp.clip(
-                    jnp.ceil(settings.cost_scaled_rounds * goal.cost(static, gs0, agg)),
+                    jnp.ceil(settings.cost_scaled_rounds * scale),
                     budget.astype(jnp.float32),
                     jnp.float32(settings.rounds_ceiling),
                 )
@@ -488,52 +570,77 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
 
         def body(c):
             agg_c, rnd, empties = c
-            if drain_fn is not None:
-                # the goal's per-replica drain priority, shared by the drain
-                # round and (on stall) the swap search
-                contrib = goal.drain_contrib(static, gs0, agg_c)
-                if getattr(goal, "rotate_drain_candidates", False):
-                    # round-seeded jitter walks the candidate ranking so a
-                    # uniformly-infeasible top-K cannot starve the goal
-                    # (drain.round_jitter; ordering is free — every
-                    # nomination is exactly re-validated before applying)
-                    from cruise_control_tpu.analyzer.drain import round_jitter
 
-                    contrib = contrib * round_jitter(contrib.shape[0], rnd)[:, None]
-                agg2, applied = drain_fn(static, agg_c, tables, gs0, contrib, rnd)
+            def engine(agg_in):
+                """The per-round search (drain/exhaustive grid + stall
+                fallbacks) — the precision tail when the bulk planner runs
+                first, the whole round otherwise."""
+                if drain_fn is not None:
+                    # the goal's per-replica drain priority, shared by the
+                    # drain round and (on stall) the swap search
+                    contrib = goal.drain_contrib(static, gs0, agg_in)
+                    if getattr(goal, "rotate_drain_candidates", False):
+                        # round-seeded jitter walks the candidate ranking so
+                        # a uniformly-infeasible top-K cannot starve the goal
+                        # (drain.round_jitter; ordering is free — every
+                        # nomination is exactly re-validated before applying)
+                        from cruise_control_tpu.analyzer.drain import round_jitter
+
+                        contrib = contrib * round_jitter(contrib.shape[0], rnd)[:, None]
+                    agg2, applied = drain_fn(static, agg_in, tables, gs0, contrib, rnd)
+                else:
+                    agg2, applied = one_round(static, agg_in, tables)
+                if swap_fn is not None:
+                    # swaps only when plain moves stalled, matching the
+                    # reference's move-first-then-swap order; `contrib` is
+                    # from agg_in, which on the stall path equals agg2
+                    agg2, swap_applied = jax.lax.cond(
+                        applied,
+                        lambda a: (a, jnp.asarray(False)),
+                        lambda a: swap_fn(static, a, tables, contrib),
+                        agg2,
+                    )
+                    applied = applied | swap_applied
+                if topic_swap_fn is not None:
+                    # band-frozen surplus pairs escape via similar-load swaps
+                    # once plain topic moves stall
+                    agg2, tswap_applied = jax.lax.cond(
+                        applied,
+                        lambda a: (a, jnp.asarray(False)),
+                        lambda a: topic_swap_fn(static, a, tables, gs0, rnd),
+                        agg2,
+                    )
+                    applied = applied | tswap_applied
+                if lead_swap_fn is not None:
+                    # paired leadership transfers once plain promotions and
+                    # moves stall (drain.make_leadership_relay_round)
+                    agg2, lswap_applied = jax.lax.cond(
+                        applied,
+                        lambda a: (a, jnp.asarray(False)),
+                        lambda a: lead_swap_fn(static, a, tables, gs0, rnd),
+                        agg2,
+                    )
+                    applied = applied | lswap_applied
+                return agg2, applied
+
+            if bulk_fn is not None:
+                # bulk surplus/deficit waves first: the whole violated set
+                # drains in a handful of conflict-free waves, and the
+                # per-round engine only executes when the planner finds
+                # nothing this round (the precision tail / stall proof)
+                agg_b, bulk_applied = bulk_fn(
+                    static, agg_c, tables, gs0,
+                    goal.drain_contrib(static, gs0, agg_c), rnd,
+                )
+                agg2, eng_applied = jax.lax.cond(
+                    bulk_applied,
+                    lambda a: (a, jnp.asarray(False)),
+                    engine,
+                    agg_b,
+                )
+                applied = bulk_applied | eng_applied
             else:
-                agg2, applied = one_round(static, agg_c, tables)
-            if swap_fn is not None:
-                # swaps only when plain moves stalled, matching the
-                # reference's move-first-then-swap order; `contrib` is from
-                # agg_c, which on the stall path equals agg2
-                agg2, swap_applied = jax.lax.cond(
-                    applied,
-                    lambda a: (a, jnp.asarray(False)),
-                    lambda a: swap_fn(static, a, tables, contrib),
-                    agg2,
-                )
-                applied = applied | swap_applied
-            if topic_swap_fn is not None:
-                # band-frozen surplus pairs escape via similar-load swaps
-                # once plain topic moves stall
-                agg2, tswap_applied = jax.lax.cond(
-                    applied,
-                    lambda a: (a, jnp.asarray(False)),
-                    lambda a: topic_swap_fn(static, a, tables, gs0, rnd),
-                    agg2,
-                )
-                applied = applied | tswap_applied
-            if lead_swap_fn is not None:
-                # paired leadership transfers once plain promotions and
-                # moves stall (drain.make_leadership_relay_round)
-                agg2, lswap_applied = jax.lax.cond(
-                    applied,
-                    lambda a: (a, jnp.asarray(False)),
-                    lambda a: lead_swap_fn(static, a, tables, gs0, rnd),
-                    agg2,
-                )
-                applied = applied | lswap_applied
+                agg2, applied = engine(agg_c)
             # a zero-cost goal with no dead-broker replicas is DONE: no
             # action can score (every improvement criterion requires reducing
             # out-of-range distance, and evacuation — which scores via the
@@ -750,10 +857,21 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                     # cost_before the first time the goal runs, stable across
                     # chunk-boundary re-entries); clip in FLOAT before the
                     # int cast — byte-denominated costs overflow int32
+                    scale = metrics_b.cost_before[gim]
+                    if (
+                        settings.bulk_waves > 0
+                        and dims.num_brokers >= settings.bulk_min_brokers
+                        and getattr(goal, "count_family", False)
+                    ):
+                        # adaptive batch schedule (mirrors goal_loop's
+                        # budget): bulk rounds drain ~one unit per violated
+                        # broker per wave, so the cap normalizes by the
+                        # entry violated set
+                        scale = scale / jnp.maximum(
+                            1.0, metrics_b.violated_before[gim].astype(jnp.float32)
+                        )
                     scaled = jnp.clip(
-                        jnp.ceil(
-                            settings.cost_scaled_rounds * metrics_b.cost_before[gim]
-                        ),
+                        jnp.ceil(settings.cost_scaled_rounds * scale),
                         cap_g.astype(jnp.float32),
                         jnp.float32(settings.rounds_ceiling),
                     )
@@ -878,9 +996,11 @@ def _state_fingerprint(agg: Aggregates) -> jax.Array:
     position-derived odd multiplier). Hashing the bit patterns, not a float
     sum: at north-star magnitudes an f32 accumulator's ulp (~2.6e5 at 4e12)
     silently absorbs exactly the small leadership-count deltas the polish
-    pass must detect. A wrap-around integer hash is exact — any single
-    changed element changes the hash unless a multi-table collision cancels
-    it (~2^-32), and a collision only costs one skipped polish retry."""
+    pass must detect. A wrap-around integer hash is strong but not perfect:
+    the forced-odd weights guarantee a LONE changed element (including a
+    sign-bit-only flip, e.g. a value becoming -0.0) always changes the hash,
+    while a multi-element change can still cancel (~2^-32) — a collision
+    only costs one skipped polish retry."""
 
     def mix(arr, salt: int):
         x = jnp.asarray(arr)
@@ -891,11 +1011,13 @@ def _state_fingerprint(agg: Aggregates) -> jax.Array:
                 x.astype(jnp.float32), jnp.uint32
             )
         flat = bits.reshape(-1)
+        # forced odd: an even weight would cancel a sign-bit-only element
+        # delta (0x80000000) mod 2^32
         w = (
             jnp.arange(1, flat.shape[0] + 1, dtype=jnp.uint32)
             * jnp.uint32(2654435761)  # Knuth multiplicative constant
             + jnp.uint32(salt)
-        )
+        ) | jnp.uint32(1)
         return jnp.sum(flat * w, dtype=jnp.uint32)
 
     fp = mix(agg.broker_load, 0x9E3779B9)

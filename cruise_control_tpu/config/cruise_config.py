@@ -120,6 +120,17 @@ def _config_def() -> ConfigDef:
              "Drain candidates pulled from each source broker's sorted run per round.")
     d.define("optimizer.drain.destination.brokers", Type.INT, 64, at_least(1), Importance.MEDIUM,
              "Destination candidates per drained replica (goal-aware lists).")
+    d.define("optimizer.bulk.count.waves", Type.INT, 16, at_least(0), Importance.MEDIUM,
+             "Max conflict-free waves per bulk count-rebalance round: count-distribution goals "
+             "drain their whole surplus/deficit grid per round instead of searching "
+             "round-by-round. 0 disables the bulk planner.")
+    d.define("optimizer.bulk.min.brokers", Type.INT, 32, at_least(0), Importance.LOW,
+             "Bulk count planner size floor: clusters smaller than this keep the per-round "
+             "engines only (they already nominate every broker per round at that scale).")
+    d.define("optimizer.polish.rounds", Type.INT, 0, at_least(0), Importance.MEDIUM,
+             "After the priority stack completes, re-run every goal up to this many rounds "
+             "under the FULL merged acceptance tables (retries goals an earlier lexicographic "
+             "pass stalled). 0 disables the polish pass.")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

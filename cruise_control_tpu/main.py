@@ -28,8 +28,14 @@ def build_simulated_service(
     two_step_verification: bool = False,
     webui_dir: str = None,
     webui_prefix: str = "/",
+    config_path: str = None,
 ):
-    """Wire the full stack over a simulated cluster; returns (app, parts)."""
+    """Wire the full stack over a simulated cluster; returns (app, parts).
+
+    `config_path`: optional cruisecontrol.properties — the analyzer keys
+    (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`
+    and the bulk count-planner knobs) map onto the goal engine through
+    BalancingConstraint.from_config / OptimizerSettings.from_config."""
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
     from cruise_control_tpu.async_ops import AsyncCruiseControl
     from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
@@ -72,8 +78,20 @@ def build_simulated_service(
     )
     runner = LoadMonitorTaskRunner(monitor)
     executor = Executor(SimulatorClusterDriver(sim, latency_polls=2), load_monitor=monitor)
+    optimizer = GoalOptimizer()
+    if config_path:
+        from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
+        from cruise_control_tpu.config.balancing import BalancingConstraint
+        from cruise_control_tpu.config.configdef import load_properties
+        from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+        cfg = CruiseControlConfig(load_properties(config_path))
+        optimizer = GoalOptimizer(
+            constraint=BalancingConstraint.from_config(cfg),
+            settings=OptimizerSettings.from_config(cfg),
+        )
     facade = CruiseControl(
-        monitor, executor, optimizer=GoalOptimizer(),
+        monitor, executor, optimizer=optimizer,
         config=FacadeConfig(
             default_requirements=ModelCompletenessRequirements(1, 0.5, False)
         ),
@@ -110,6 +128,9 @@ def main(argv=None) -> int:
     parser.add_argument("--simulate-brokers", type=int, default=12)
     parser.add_argument("--simulate-topics", type=int, default=20)
     parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--config", default=None, metavar="PATH",
+                        help="cruisecontrol.properties; analyzer keys (balancing "
+                             "thresholds, optimizer.*) map onto the goal engine")
     parser.add_argument("--two-step-verification", action="store_true")
     parser.add_argument("--access-log", default=None, metavar="PATH",
                         help="append HTTP requests to PATH in NCSA combined format")
@@ -139,6 +160,7 @@ def main(argv=None) -> int:
         num_brokers=args.simulate_brokers, num_topics=args.simulate_topics,
         seed=args.seed, two_step_verification=args.two_step_verification,
         webui_dir=args.webui_dir, webui_prefix=args.webui_prefix,
+        config_path=args.config,
     )
     if args.operation_log:
         import logging

@@ -258,6 +258,178 @@ class TestFullStack:
             assert gf.violated_brokers_after == gc.violated_brokers_after, gf.name
 
 
+class TestBulkCountPlanner:
+    """Bulk count-rebalance planner (analyzer.bulk): the surplus/deficit
+    wave kernel must land the closed-form targets — every per-broker count
+    inside the floor/ceil balance window — in far fewer rounds than the
+    one-unit-per-round greedy it replaces, while preserving the greedy's
+    one-action-at-a-time acceptance semantics (every wave action is exactly
+    validated at application time)."""
+
+    COUNT_GOALS = ["ReplicaDistributionGoal", "LeaderReplicaDistributionGoal"]
+
+    @pytest.fixture(scope="class")
+    def model(self):
+        prop = generators.ClusterProperty(
+            num_racks=4, num_brokers=10, num_topics=12,
+            mean_partitions_per_topic=6.0, replication_factor=2,
+            load_distribution="exponential", mean_utilization=0.4,
+        )
+        return generators.random_cluster(seed=13, prop=prop)
+
+    @pytest.fixture(scope="class")
+    def bulk_result(self, model):
+        # batch_k=1: the per-round fallback engine applies ONE action per
+        # round, so converging the ~24-cost replica goal within the round
+        # budget asserted below is only possible through the planner's waves
+        settings = OptimizerSettings(
+            batch_k=1, max_rounds_per_goal=64, bulk_min_brokers=1
+        )
+        return GoalOptimizer(settings=settings).optimizations(
+            model, self.COUNT_GOALS, raise_on_hard_failure=False
+        )
+
+    def test_counts_land_in_window(self, model, bulk_result):
+        """The closed-form targets hold: every alive broker's replica and
+        leader counts inside the balance window (zero violated brokers) —
+        the same end state the round-by-round greedy converges to."""
+        fixed = model._replace(assignment=bulk_result.final_assignment)
+        sanity_check(fixed)
+        after = _violations(fixed, self.COUNT_GOALS)
+        assert after == {n: 0 for n in self.COUNT_GOALS}
+        for g in bulk_result.goal_results:
+            assert g.converged, g.name
+            assert g.violated_brokers_after == 0, g.name
+            assert g.cost_after == 0.0, g.name
+
+    def test_count_goal_round_budget(self, bulk_result):
+        """Fast regression guard (CI): count goals must stay on the bulk
+        path — dropping back to one-unit rounds would blow this budget (the
+        replica goal alone enters at cost ~24, i.e. ~24 greedy rounds)."""
+        for g in bulk_result.goal_results:
+            assert g.rounds <= 64, (g.name, g.rounds)
+            assert g.rounds < max(2.0, g.cost_before), (g.name, g.rounds)
+
+    @pytest.mark.slow
+    def test_bulk_matches_greedy_parity(self, model):
+        """OptimizationVerifier-style parity over the whole count family:
+        the planner may not violate any goal the round-by-round greedy
+        (bulk_waves=0, cost-scaled round caps) satisfies, and may not
+        regress any final cost beyond epsilon."""
+        goals = [
+            "ReplicaDistributionGoal", "TopicReplicaDistributionGoal",
+            "LeaderReplicaDistributionGoal", "LeaderBytesInDistributionGoal",
+        ]
+        bulk = GoalOptimizer(settings=OptimizerSettings(
+            batch_k=1, max_rounds_per_goal=64, bulk_min_brokers=1,
+        )).optimizations(model, goals, raise_on_hard_failure=False)
+        greedy = GoalOptimizer(settings=OptimizerSettings(
+            batch_k=1, bulk_waves=0, max_rounds_per_goal=64,
+            cost_scaled_rounds=1.5, rounds_ceiling=2048,
+        )).optimizations(model, goals, raise_on_hard_failure=False)
+        for bg, gg in zip(bulk.goal_results, greedy.goal_results):
+            assert bg.violated_brokers_after <= gg.violated_brokers_after, bg.name
+            assert bg.cost_after <= gg.cost_after + 0.05 * max(gg.cost_after, 1.0) + 1e-3, (
+                bg.name, bg.cost_after, gg.cost_after
+            )
+        # the bulk run's placement satisfies every window the greedy satisfied
+        fixed = model._replace(assignment=bulk.final_assignment)
+        sanity_check(fixed)
+        after = _violations(fixed, goals)
+        for gg in greedy.goal_results:
+            if gg.violated_brokers_after == 0:
+                assert after[gg.name] == 0, gg.name
+
+
+def _skewed_model(seed=21):
+    """Seeded random cluster with replicas piled onto broker 0 (a real
+    surplus for the count goals to drain)."""
+    model = generators.random_cluster(
+        seed=seed,
+        prop=generators.ClusterProperty(
+            num_racks=3, num_brokers=9, num_topics=10,
+            mean_partitions_per_topic=5.0, replication_factor=2,
+            load_distribution="exponential",
+        ),
+    )
+    a = np.asarray(model.assignment).copy()
+    for p in range(0, a.shape[0], 2):
+        if 0 not in a[p]:
+            a[p, 1] = 0  # move p's follower onto broker 0
+    return model._replace(assignment=a)
+
+
+def test_bulk_round_is_conflict_free_and_consistent():
+    """Wave-conflict-freedom property: after one bulk round, the
+    incrementally updated aggregates must equal a full recompute from the
+    resulting assignment (two conflicting actions in a wave would corrupt
+    the incremental bookkeeping), the placement stays structurally sane, and
+    the goal's cost only drops — by more than one unit, i.e. the round
+    batched several greedy steps."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.acceptance import empty_tables
+    from cruise_control_tpu.analyzer.bulk import make_bulk_count_round
+    from cruise_control_tpu.analyzer.goals import get_goal
+
+    model = _skewed_model()
+    dims = dims_of(model)
+    static = build_static_ctx(model, BalancingConstraint.default(), dims)
+    agg = compute_aggregates(static, jnp.asarray(model.assignment), dims)
+    goal = get_goal("ReplicaDistributionGoal")
+    gs = goal.prepare(static, agg, dims)
+    cost0 = float(goal.cost(static, gs, agg))
+    assert cost0 > 2.0  # the skew produced a real surplus
+    bulk = make_bulk_count_round(goal, dims, 4, 8)
+    agg2, applied = bulk(
+        static, agg, empty_tables(dims), gs,
+        goal.drain_contrib(static, gs, agg), jnp.int32(0),
+    )
+    assert bool(applied)
+    recomputed = compute_aggregates(static, agg2.assignment, dims)
+    for name in agg2._fields:
+        np.testing.assert_allclose(
+            np.asarray(getattr(agg2, name)),
+            np.asarray(getattr(recomputed, name)),
+            rtol=1e-5, atol=1e-3, err_msg=name,
+        )
+    sanity_check(model._replace(assignment=np.asarray(agg2.assignment)))
+    cost1 = float(goal.cost(static, gs, agg2))
+    assert cost1 <= cost0 - 2.0, (cost0, cost1)
+
+
+def test_rank_paired_destinations_contract():
+    """Pairing property (context.rank_paired_destinations): destinations
+    come from the feasible (finite-key) prefix, consecutive valid sources
+    receive distinct destinations within one wave, and rotating the offset
+    cycles a source through every feasible destination."""
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer.context import rank_paired_destinations
+
+    rng = np.random.default_rng(5)
+    b = 16
+    key = np.where(
+        rng.random(b) < 0.5, rng.random(b), -np.inf
+    ).astype(np.float32)
+    key[3] = 1.5  # at least one feasible destination
+    valid = rng.random(b) < 0.6
+    feasible = set(np.nonzero(np.isfinite(key))[0].tolist())
+    valid_ids = np.nonzero(valid)[0]
+    seen_by_first = set()
+    for off in range(len(feasible)):
+        paired = np.asarray(
+            rank_paired_destinations(
+                jnp.asarray(valid), jnp.asarray(key), jnp.int32(off)
+            )
+        )
+        assert set(paired[valid].tolist()) <= feasible
+        window = paired[valid_ids[: len(feasible)]]
+        assert len(set(window.tolist())) == len(window)
+        seen_by_first.add(int(paired[valid_ids[0]]))
+    assert seen_by_first == feasible
+
+
 class TestOptions:
     def test_excluded_partitions_never_move(self):
         model = generators.capacity_violated()

@@ -115,6 +115,34 @@ def test_dead_broker_evacuation_with_selective_goals(model):
         sanity_check(dead_model._replace(assignment=result.final_assignment))
 
 
+@pytest.mark.slow
+def test_count_goal_subset_with_bulk_planner(model):
+    """RandomSelfHealingTest analog through the bulk count planner
+    (analyzer.bulk, gate lowered below the 12-broker model): a count-goal
+    subset on a dead-broker model must evacuate the dead broker and never
+    regress the requested goals' costs — every planner wave is exactly
+    validated, so the invariants match the per-round engines'."""
+    state = np.asarray(model.broker_state).copy()
+    state[3] = BrokerState.DEAD
+    dead_model = model._replace(broker_state=state)
+    settings = OptimizerSettings(
+        batch_k=32, max_rounds_per_goal=24, num_dst_candidates=8,
+        num_swap_pairs=8, swap_candidates=8, apply_waves=4, bulk_min_brokers=1,
+    )
+    result = GoalOptimizer(settings=settings).optimizations(
+        dead_model,
+        goal_names=[
+            "ReplicaCapacityGoal", "ReplicaDistributionGoal",
+            "LeaderBytesInDistributionGoal",
+        ],
+        raise_on_hard_failure=False,
+    )
+    assert not (result.final_assignment == 3).any()
+    for g in result.goal_results:
+        assert g.cost_after <= g.cost_before + 1e-4, g.name
+    sanity_check(dead_model._replace(assignment=result.final_assignment))
+
+
 @pytest.mark.parametrize(
     "trial",
     # every trial's goal subset is a distinct XLA program: one rides the

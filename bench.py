@@ -29,9 +29,14 @@ the largest *completed* config as the last line (parse the last line). Each
 line carries per-goal "goalRounds" and "goalDurS" maps (goal names
 abbreviated by _short_goal) as top-level parsed fields so round/duration
 regressions are visible without the detail file. The full per-goal and
-parity tables go to BENCH_DETAIL.json next to this file and to stderr. All
-diagnostics go to stderr, flushed, starting with backend/device info so a
-hang is attributable.
+parity tables go to BENCH_DETAIL.json next to this file and to stderr,
+along with an `observability` block per config — per-goal tracer span
+summaries (engine/rounds/converged), rounds by engine, recompile count, the
+optimizer round-time histogram (p50/p95/p99), tracing overhead vs proposal
+wall (<2% contract; the compact line carries `tracingOverheadPct`), and the
+sensor-registry snapshot — so the perf trajectory records WHY a run was
+fast or slow, not just totals. All diagnostics go to stderr, flushed,
+starting with backend/device info so a hang is attributable.
 
 `value` is the steady-state proposal-generation wall-clock (the production
 regime: the proposal precompute loop reuses compiled kernels across model
@@ -220,18 +225,74 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
 
     Chunked mode compiles with a single budget-1 call (GoalOptimizer.warmup)
     instead of a full optimization — the budget is a traced scalar, so the
-    timed pass reuses the exact compiled program."""
+    timed pass reuses the exact compiled program.
+
+    The timed pass runs under a bench root span, and the result carries its
+    trace id + recompile/tracer-overhead deltas so _observability_block can
+    scope the span summaries to exactly this measurement."""
+    from cruise_control_tpu.common.sensors import REGISTRY
+    from cruise_control_tpu.common.tracing import TRACER
+
     t0 = time.monotonic()
     optimizer.warmup(
         model, goal_names=kw.get("goal_names"),
         options=kw.get("options") or _default_options(),
     )
     log(f"[config {cfg_id}] {tag} warmup (compile) pass: {time.monotonic() - t0:.1f}s")
+    recompiles0 = REGISTRY.meter("GoalOptimizer.program-cache-misses").snapshot()["count"]
+    overhead0 = TRACER.overhead_s
     t0 = time.monotonic()
-    result = optimizer.optimizations(model, raise_on_hard_failure=False, **kw)
+    with TRACER.span(f"bench.{tag}", kind="bench", config=cfg_id) as root:
+        result = optimizer.optimizations(model, raise_on_hard_failure=False, **kw)
     wall = time.monotonic() - t0
+    result._bench_trace_id = root.trace_id
+    result._bench_recompiles = (
+        REGISTRY.meter("GoalOptimizer.program-cache-misses").snapshot()["count"]
+        - recompiles0
+    )
+    result._bench_tracing_overhead_s = TRACER.overhead_s - overhead0
     _log_pass(cfg_id, f"{tag} timed", wall, result)
     return wall, result
+
+
+def _observability_block(result, wall: float) -> dict:
+    """Why the run was fast or slow, not just totals (BENCH_DETAIL.json):
+    per-goal spans (engine/rounds/converged), rounds by engine, recompile
+    count, the round-time histogram (p50/p95/p99), tracer overhead vs the
+    proposal wall (acceptance gate: <2%), and the sensor-registry snapshot."""
+    from cruise_control_tpu.common.sensors import REGISTRY
+    from cruise_control_tpu.common.tracing import TRACER
+
+    tid = getattr(result, "_bench_trace_id", None)
+    goal_spans = []
+    rounds_by_engine: dict = {}
+    # recent() is newest-first; reverse back into stack priority order
+    for s in reversed(TRACER.recent(limit=512, kind="goal", trace_id=tid)):
+        a = s["attributes"]
+        goal_spans.append(
+            {
+                "goal": _short_goal(a.get("goal", s["name"])),
+                "engine": a.get("engine"),
+                "rounds": a.get("rounds"),
+                "converged": a.get("converged"),
+                "durationS": s["durationS"],
+            }
+        )
+        eng = a.get("engine", "?")
+        rounds_by_engine[eng] = rounds_by_engine.get(eng, 0) + int(a.get("rounds") or 0)
+    snap = REGISTRY.snapshot()
+    overhead = float(getattr(result, "_bench_tracing_overhead_s", 0.0))
+    return {
+        "goalSpans": goal_spans,
+        "roundsByEngine": rounds_by_engine,
+        "recompiles": getattr(result, "_bench_recompiles", None),
+        "roundTimer": snap.get("GoalOptimizer.optimizer-round-timer"),
+        "deviceCallTimer": snap.get("GoalOptimizer.device-call-timer"),
+        "tracingOverheadS": round(overhead, 6),
+        "tracingOverheadPct": round(100.0 * overhead / max(wall, 1e-9), 4),
+        "spanSummary": TRACER.summarize(),
+        "sensors": snap,
+    }
 
 
 def _default_options():
@@ -305,9 +366,14 @@ def _parity5(seed: int, mesh, batched_settings) -> dict:
     )
     batched = GoalOptimizer(settings=batched_settings, mesh=mesh)
     b_wall, b_result = _timed(batched, model, 5, "parity batched")
+    # scope the observability block to the batched parity pass before the
+    # greedy pass pollutes the registry/ring (the 520-broker acceptance
+    # record: per-goal engine/round/recompile summaries + tracing overhead)
+    obs = _observability_block(b_result, b_wall)
     greedy = GoalOptimizer(settings=_settings(batched=False))
     g_wall, g_result = _timed(greedy, model, 5, "parity greedy")
     block = _parity_block(5, b_result, g_wall, g_result)
+    block["observability"] = obs
     block["parityScale"] = f"{model.num_brokers}B/{model.num_partitions}P"
     block["batchedWallS"] = round(b_wall, 3)
     return block
@@ -371,7 +437,9 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
             "removeEvacuatedCleanly": evacuated,
         }
         payload.update(_goal_payload_fields(add_result))
-        detail = {"goals": _goal_table(add_result)}
+        obs = _observability_block(add_result, add_wall)
+        payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
+        detail = {"goals": _goal_table(add_result), "observability": obs}
         if parity:
             greedy = GoalOptimizer(settings=_settings(batched=False))
             greedy_wall, greedy_result = _timed(
@@ -419,9 +487,12 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         "violatedAfterCount": len(result.violated_goals_after),
     }
     payload.update(_goal_payload_fields(result))
+    obs = _observability_block(result, wall)
+    payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
     detail = {
         "goals": _goal_table(result),
         "violatedAfter": result.violated_goals_after,
+        "observability": obs,
     }
     if cfg_id == 5:
         payload["vs_baseline"] = round(TARGET_S / wall, 3)

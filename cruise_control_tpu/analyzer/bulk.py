@@ -205,4 +205,11 @@ def make_bulk_count_round(goal, dims, k_cand: int, max_waves: int):
             agg,
         )
 
-    return bulk_round
+    def named_bulk_round(static, agg, tables, gs, contrib, rnd=jnp.int32(0)):
+        # named_scope at trace time: the planner's kernels carry this name in
+        # xplane op metadata, so profiler captures separate bulk waves from
+        # the per-round engines (docs/OBSERVABILITY.md correlation)
+        with jax.named_scope(f"cc-bulk-{goal.name}"):
+            return bulk_round(static, agg, tables, gs, contrib, rnd)
+
+    return named_bulk_round

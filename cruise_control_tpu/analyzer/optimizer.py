@@ -86,6 +86,8 @@ from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
 from cruise_control_tpu.analyzer.stats import ClusterModelStats, compute_stats, stats_to_dict
 from cruise_control_tpu.common.resources import PartMetric
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.common.tracing import TRACER, maybe_profile
 from cruise_control_tpu.config.balancing import BalancingConstraint
 from cruise_control_tpu.models.flat_model import FlatClusterModel
 
@@ -211,6 +213,28 @@ class OptimizerSettings:
             bulk_min_brokers=config.get_int("optimizer.bulk.min.brokers"),
             polish_rounds=config.get_int("optimizer.polish.rounds"),
         )
+
+
+def goal_engine(goal, dims: "Dims", settings: OptimizerSettings) -> str:
+    """Which search engine a goal runs under these settings/dims — the
+    `engine` attribute on per-goal tracer spans and the bench's span
+    summaries (mirrors the use_bulk/use_drain wiring in _make_goal_loop)."""
+    use_bulk = (
+        settings.bulk_waves > 0
+        and dims.num_brokers >= settings.bulk_min_brokers
+        and getattr(goal, "count_family", False)
+    )
+    use_drain = (
+        settings.batch_k > 1
+        or getattr(goal, "uses_swaps", False)
+        or (use_bulk and getattr(goal, "pair_drain", False))
+    )
+    engine = "drain" if use_drain else "grid"
+    if use_bulk:
+        engine = f"bulk+{engine}"
+    if settings.polish_rounds > 0:
+        engine += "+polish"
+    return engine
 
 
 # -- per-round kernels ---------------------------------------------------------
@@ -713,17 +737,21 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
         tables = empty_tables(dims)
         vb, va, cb, ca, rs, cv, fps = [], [], [], [], [], [], []
         for goal, loop in zip(goals, loops):
-            gs0 = goal.prepare(static, agg, dims)
-            vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
-            cb.append(goal.cost(static, gs0, agg).astype(jnp.float32))
-            agg, rounds, empties = loop(static, agg, tables)
-            gs1 = goal.prepare(static, agg, dims)
-            va.append(jnp.sum(goal.broker_violation(static, gs1, agg)).astype(jnp.int32))
-            ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
-            rs.append(rounds)
-            cv.append(empties >= loop.empties_to_stall)
-            fps.append(_state_fingerprint(agg))
-            tables = goal.contribute_acceptance(static, gs1, tables)
+            # named_scope: xplane op names carry the goal, so a profiler
+            # capture (scripts/parse_xplane.py) joins against the tracer's
+            # per-goal spans by name (docs/OBSERVABILITY.md)
+            with jax.named_scope(f"cc-goal-{goal.name}"):
+                gs0 = goal.prepare(static, agg, dims)
+                vb.append(jnp.sum(goal.broker_violation(static, gs0, agg)).astype(jnp.int32))
+                cb.append(goal.cost(static, gs0, agg).astype(jnp.float32))
+                agg, rounds, empties = loop(static, agg, tables)
+                gs1 = goal.prepare(static, agg, dims)
+                va.append(jnp.sum(goal.broker_violation(static, gs1, agg)).astype(jnp.int32))
+                ca.append(goal.cost(static, gs1, agg).astype(jnp.float32))
+                rs.append(rounds)
+                cv.append(empties >= loop.empties_to_stall)
+                fps.append(_state_fingerprint(agg))
+                tables = goal.contribute_acceptance(static, gs1, tables)
         if settings.polish_rounds > 0:
             # polish pass under the FULL merged tables (see
             # OptimizerSettings.polish_rounds); this traces every goal loop a
@@ -735,16 +763,17 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
                 # state after this goal stalled (mirrors the chunked
                 # machine's fingerprint-based skip_polish + halved stall
                 # threshold)
-                skip = cv[i] & (_state_fingerprint(agg) == fps[i])
-                stall_g = jnp.int32(max(1, loop.empties_to_stall // 2))
-                agg, rounds, empties = loop(
-                    static, agg, tables,
-                    jnp.where(skip, jnp.int32(0), jnp.int32(settings.polish_rounds)),
-                    stall_at=stall_g,
-                )
-                rs[i] = rs[i] + rounds
-                cv[i] = jnp.where(skip, cv[i], empties >= stall_g)
-                fps[i] = _state_fingerprint(agg)
+                with jax.named_scope(f"cc-polish-{goal.name}"):
+                    skip = cv[i] & (_state_fingerprint(agg) == fps[i])
+                    stall_g = jnp.int32(max(1, loop.empties_to_stall // 2))
+                    agg, rounds, empties = loop(
+                        static, agg, tables,
+                        jnp.where(skip, jnp.int32(0), jnp.int32(settings.polish_rounds)),
+                        stall_at=stall_g,
+                    )
+                    rs[i] = rs[i] + rounds
+                    cv[i] = jnp.where(skip, cv[i], empties >= stall_g)
+                    fps[i] = _state_fingerprint(agg)
             for i, goal in enumerate(goals):
                 gs1 = goal.prepare(static, agg, dims)
                 va[i] = jnp.sum(
@@ -950,7 +979,13 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 emp2 = jnp.where(done_goal, jnp.int32(0), emp2)
                 return agg2, tables2, gi2, rig2, emp2, metrics_b, left - rounds
 
-            return branch
+            def named_branch(op):
+                # named_scope at trace time: this goal's switch branch carries
+                # its name in xplane op metadata (parse_xplane.py correlation)
+                with jax.named_scope(f"cc-goal-{goal.name}"):
+                    return branch(op)
+
+            return named_branch
 
         branches = [make_branch(g, l) for g, l in zip(goals, loops)]
 
@@ -1076,24 +1111,36 @@ def _compile_cached(key, tag, dims, build):
     with _BUILD_LOCK:
         ex = _COMPILED_STACKS.get(key)
         if ex is None:
+            REGISTRY.meter("GoalOptimizer.program-cache-misses").mark()
+            # the span that triggered this compile (proposal/warmup) pays the
+            # recompile; flag it so span readers can split cold from warm
+            TRACER.add_attributes(recompile=True)
             t0 = time.monotonic()
             log.info(
                 "compiling %s: P=%d B=%d T=%d",
                 tag, dims.num_partitions, dims.num_brokers, dims.num_topics,
             )
-            lowered = build()
-            t1 = time.monotonic()
-            ex = lowered.compile()
+            with TRACER.span("optimizer.compile", kind="compile", program=tag):
+                lowered = build()
+                t1 = time.monotonic()
+                ex = lowered.compile()
             log.info(
                 "%s compiled in %.1fs (trace/lower %.1fs, XLA %.1fs)",
                 tag, time.monotonic() - t0, t1 - t0, time.monotonic() - t1,
+            )
+            REGISTRY.histogram("GoalOptimizer.stack-compile-timer").record(
+                time.monotonic() - t0
             )
             _COMPILED_STACKS[key] = ex
             while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
                 _COMPILED_STACKS.popitem(last=False)
         else:
+            REGISTRY.meter("GoalOptimizer.program-cache-hits").mark()
             _COMPILED_STACKS.move_to_end(key)
     return ex
+
+
+REGISTRY.gauge("GoalOptimizer.program-cache-size", lambda: len(_COMPILED_STACKS))
 
 
 def _trace_settings(settings: OptimizerSettings) -> OptimizerSettings:
@@ -1259,15 +1306,37 @@ class GoalOptimizer:
         durs = np.zeros(n, np.float64)
         rounds_seen = np.zeros(n, np.int64)
         last_gi = 0
+        gi_entry = 0
+        round_hist = REGISTRY.histogram("GoalOptimizer.optimizer-round-timer")
+        call_hist = REGISTRY.histogram("GoalOptimizer.device-call-timer")
+        dispatches = REGISTRY.meter("GoalOptimizer.device-dispatches")
         t_stack = time.monotonic()
         while True:
             t_call = time.monotonic()
-            agg, tables, gi, rig, emp, metrics, spent = machine(
-                static, agg, tables, gi, rig, emp, metrics,
-                jnp.int32(max(1, chunk)),
-            )
-            gi_h, spent_h, rounds_h = jax.device_get((gi, spent, metrics.rounds))
+            # one tracer span per bounded device dispatch, annotated into the
+            # profiler timeline so xplane captures join against /trace spans
+            with TRACER.span(
+                "optimizer.device-call", kind="device-call",
+                goal=goal_names[min(gi_entry % n, n - 1)],
+                phase="polish" if gi_entry >= n else "main",
+                budget=int(max(1, chunk)),
+            ) as call_span, jax.profiler.TraceAnnotation("cc-machine-call"):
+                agg, tables, gi, rig, emp, metrics, spent = machine(
+                    static, agg, tables, gi, rig, emp, metrics,
+                    jnp.int32(max(1, chunk)),
+                )
+                gi_h, spent_h, rounds_h = jax.device_get((gi, spent, metrics.rounds))
+                call_span.attributes["rounds"] = int(spent_h)
+                call_span.attributes["goalIndexAfter"] = int(gi_h)
             call_s = time.monotonic() - t_call
+            dispatches.mark()
+            call_hist.record(call_s)
+            if int(spent_h) > 0:
+                # one sample per dispatch of the call's mean round latency:
+                # the per-round distribution /metrics reports p50/p95/p99 over
+                # (rounds inside one XLA call are not individually observable)
+                round_hist.record(call_s / int(spent_h))
+            gi_entry = int(gi_h)
             # attribute this call's wall-clock to goals by their round share
             delta = np.maximum(rounds_h.astype(np.int64) - rounds_seen, 0)
             if delta.sum() > 0:
@@ -1374,6 +1443,11 @@ class GoalOptimizer:
         same shape pays zero compile. The production precompute loop
         (GoalOptimizer.java:129 background thread) is the reference analog."""
         t0 = time.monotonic()
+        with TRACER.span("optimizer.warmup", kind="compile",
+                         brokers=int(model.num_brokers)):
+            return self._warmup(model, goal_names, options, t0)
+
+    def _warmup(self, model, goal_names, options, t0) -> float:
         goals, _, model, dims, static, agg = self._prepare(model, goal_names, options)
         goal_names_t = tuple(g.name for g in goals)
         # the stats program runs in every optimizations() call too — without
@@ -1426,9 +1500,37 @@ class GoalOptimizer:
         OperationProgress steps (cc/async/progress/OptimizationForGoal) — is
         invoked per goal in one burst AFTER the stack completes, with each
         goal's round-share of the measured stack wall-clock (an attribution,
-        not a per-goal measurement; compile time is excluded)."""
-        from cruise_control_tpu.common.sensors import REGISTRY
+        not a per-goal measurement; compile time is excluded).
 
+        Observability: the whole computation runs under a `proposal` tracer
+        span with per-goal `goal` child spans (engine/rounds/cost attributes)
+        and `device-call` spans per dispatch; an armed profile dir
+        (tracing.set_profile_dir / `observability.profile.dir`) captures ONE
+        computation's xplane trace here."""
+        with maybe_profile() as profiled, TRACER.span(
+            "proposal-computation", kind="proposal",
+            brokers=int(model.num_brokers),
+            partitions=int(model.num_partitions),
+            profiled=bool(profiled),
+        ) as root:
+            result = self._optimizations(
+                model, goal_names, options, raise_on_hard_failure, progress
+            )
+            root.attributes.update(
+                numProposals=len(result.proposals),
+                replicaMoves=result.num_replica_moves,
+                leadershipMoves=result.num_leadership_moves,
+            )
+            return result
+
+    def _optimizations(
+        self,
+        model: FlatClusterModel,
+        goal_names: Optional[Sequence[str]],
+        options: OptimizationOptions,
+        raise_on_hard_failure: bool,
+        progress,
+    ) -> OptimizerResult:
         t0 = time.monotonic()
         goals, p_orig, model, dims, static, agg = self._prepare(
             model, goal_names, options
@@ -1459,9 +1561,15 @@ class GoalOptimizer:
                 goal_names_t, dims, self._settings, self._mesh, static, agg
             )
             t_stack = time.monotonic()
-            agg, metrics = step(static, agg)
-            jax.block_until_ready(metrics)
+            with TRACER.span(
+                "optimizer.stack-call", kind="device-call",
+                goal="<fused-stack>", phase="main",
+            ), jax.profiler.TraceAnnotation("cc-stack-call"):
+                agg, metrics = step(static, agg)
+                jax.block_until_ready(metrics)
             stack_s = time.monotonic() - t_stack
+            REGISTRY.meter("GoalOptimizer.device-dispatches").mark()
+            REGISTRY.histogram("GoalOptimizer.device-call-timer").record(stack_s)
 
         final_model = model._replace(assignment=agg.assignment)
         stats_after = _jit_compute_stats(final_model, dims.num_topics)
@@ -1471,6 +1579,14 @@ class GoalOptimizer:
         metrics, stats_before, stats_after, init_np, final_np = jax.device_get(
             (metrics, stats_before, stats_after, init_assignment, agg.assignment)
         )
+        if goal_durs is None:
+            # fused mode: per-round latency is only observable as the stack
+            # mean (chunked mode records one sample per dispatch instead)
+            total_rounds = int(metrics.rounds.sum())
+            if total_rounds > 0:
+                REGISTRY.histogram("GoalOptimizer.optimizer-round-timer").record(
+                    stack_s / total_rounds
+                )
 
         goal_results: List[GoalResult] = []
         first_hard_failure: Optional[GoalResult] = None
@@ -1494,6 +1610,18 @@ class GoalOptimizer:
                 ),
             )
             goal_results.append(gr)
+            # synthetic per-goal span: the goal ran INSIDE a fused/chunked XLA
+            # program, so its interval is attributed (round share of measured
+            # stack wall), not host-observed — same contract as duration_s
+            TRACER.record_span(
+                f"goal:{goal.name}", kind="goal", duration_s=gr.duration_s,
+                goal=goal.name,
+                engine=goal_engine(goal, dims, self._settings),
+                rounds=gr.rounds, converged=gr.converged,
+                costBefore=gr.cost_before, costAfter=gr.cost_after,
+                violatedBefore=gr.violated_brokers_before,
+                violatedAfter=gr.violated_brokers_after,
+            )
             if progress is not None:
                 progress(goal.name, gr.duration_s)
             if gr.is_hard and gr.violated_brokers_after > 0 and first_hard_failure is None:
@@ -1518,8 +1646,9 @@ class GoalOptimizer:
         )
         data_mb = sum(pr.data_to_move_mb for pr in proposals)
         wall = time.monotonic() - t0
-        REGISTRY.timer("GoalOptimizer.proposal-computation-timer").record(wall)
-        REGISTRY.timer("GoalOptimizer.stack-execution-timer").record(stack_s)
+        # hot timers are histograms: /metrics serves their p50/p95/p99
+        REGISTRY.histogram("GoalOptimizer.proposal-computation-timer").record(wall)
+        REGISTRY.histogram("GoalOptimizer.stack-execution-timer").record(stack_s)
         return OptimizerResult(
             proposals=proposals,
             goal_results=goal_results,

@@ -18,4 +18,13 @@ OPERATION_LOG = logging.getLogger("operationLogger")
 
 
 def op_log(fmt: str, *args) -> None:
-    OPERATION_LOG.info(fmt, *args)
+    """Log one operation line; when the calling thread is inside a tracer
+    span, the trace id is appended so the audit trail joins against `/trace`
+    spans and JSONL sinks (common/tracing.py)."""
+    from cruise_control_tpu.common.tracing import TRACER
+
+    trace_id = TRACER.current_trace_id()
+    if trace_id:
+        OPERATION_LOG.info(fmt + " [trace=%s]", *args, trace_id)
+    else:
+        OPERATION_LOG.info(fmt, *args)

@@ -334,6 +334,17 @@ def _config_def() -> ConfigDef:
              "Mesh axis name candidate/partition arrays are sharded over.")
     d.define("tpu.donate.model.buffers", Type.BOOLEAN, True, None, Importance.LOW,
              "Donate model buffers between optimizer rounds to avoid copies.")
+    # --- observability (TPU-native keys; docs/OBSERVABILITY.md)
+    d.define("observability.trace.ring.size", Type.INT, 4096, at_least(16), Importance.LOW,
+             "Completed tracer spans retained in memory (the /trace window); "
+             "oldest spans drop first.")
+    d.define("observability.trace.jsonl.path", Type.STRING, "", None, Importance.LOW,
+             "Append every completed tracer span as one JSON line to this file "
+             "(durable traces); empty = disabled.")
+    d.define("observability.profile.dir", Type.STRING, "", None, Importance.LOW,
+             "Arm a one-shot JAX profiler capture: the first proposal computation "
+             "after startup writes an xplane trace here (parse with "
+             "scripts/parse_xplane.py); empty = disabled.")
     return d
 
 

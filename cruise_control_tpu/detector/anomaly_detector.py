@@ -64,20 +64,26 @@ class AnomalyDetector:
 
     def detect_once(self) -> int:
         """Run all three detectors, queue anomalies; returns queued count."""
-        found: List[Anomaly] = []
-        bf = self._bf.detect()
-        if bf:
-            found.append(bf)
-        gv = self._gv.detect()
-        if gv:
-            found.append(gv)
-        found.extend(self._ma.detect())
-        for a in found:
-            self._counts[a.anomaly_type.name] += 1
-            self._recent.append(a.describe())
-            self._recent = self._recent[-50:]
-            self._queue.put(a)
-        return len(found)
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("anomaly-sweep", kind="detector") as span, \
+                REGISTRY.histogram("AnomalyDetector.detection-timer"):
+            found: List[Anomaly] = []
+            bf = self._bf.detect()
+            if bf:
+                found.append(bf)
+            gv = self._gv.detect()
+            if gv:
+                found.append(gv)
+            found.extend(self._ma.detect())
+            for a in found:
+                self._counts[a.anomaly_type.name] += 1
+                self._recent.append(a.describe())
+                self._recent = self._recent[-50:]
+                self._queue.put(a)
+            span.attributes["anomalies"] = len(found)
+            return len(found)
 
     def handle_once(self, block_s: float = 0.0) -> Optional[str]:
         """Consume one queued anomaly (AnomalyHandlerTask); returns the action
@@ -92,21 +98,29 @@ class AnomalyDetector:
             self._requeue_later(anomaly, delay_s=1.0)
             return AnomalyNotificationResult.CHECK.name
         from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.tracing import TRACER
 
-        result, delay_s = self._notifier.on_anomaly(anomaly, now_ms)
-        op_log("Anomaly %s: notifier decided %s", anomaly, result.name)
-        if result == AnomalyNotificationResult.FIX:
-            try:
-                anomaly.fix(self._facade)
-                self._fixes[anomaly.anomaly_type.name] += 1
-                op_log("Self-healing fix completed for %s", anomaly)
-            except Exception as e:
-                # fix failures surface through executor/notifier state, but
-                # the audit trail must still record them
-                op_log("Self-healing fix FAILED for %s: %r", anomaly, e)
-        elif result == AnomalyNotificationResult.CHECK:
-            self._requeue_later(anomaly, delay_s)
-        return result.name
+        # the span threads one trace id through the decision, the (possibly
+        # long) self-healing fix, and every op_log line they emit
+        with TRACER.span(
+            "anomaly-handle", kind="detector",
+            anomalyType=anomaly.anomaly_type.name,
+        ) as span:
+            result, delay_s = self._notifier.on_anomaly(anomaly, now_ms)
+            span.attributes["decision"] = result.name
+            op_log("Anomaly %s: notifier decided %s", anomaly, result.name)
+            if result == AnomalyNotificationResult.FIX:
+                try:
+                    anomaly.fix(self._facade)
+                    self._fixes[anomaly.anomaly_type.name] += 1
+                    op_log("Self-healing fix completed for %s", anomaly)
+                except Exception as e:
+                    # fix failures surface through executor/notifier state, but
+                    # the audit trail must still record them
+                    op_log("Self-healing fix FAILED for %s: %r", anomaly, e)
+            elif result == AnomalyNotificationResult.CHECK:
+                self._requeue_later(anomaly, delay_s)
+            return result.name
 
     def _requeue_later(self, anomaly: Anomaly, delay_s: float) -> None:
         t = threading.Timer(delay_s, lambda: self._queue.put(anomaly))

@@ -152,35 +152,41 @@ class Executor:
                 self._demoted_brokers[b] = now
 
         from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.tracing import TRACER
 
-        self._notifier("execution_started", {"numProposals": len(proposals)})
-        op_log(
-            "Execution started: %d proposal(s), removed=%s demoted=%s",
-            len(proposals), sorted(removed_brokers or ()), sorted(demoted_brokers or ()),
-        )
-        if self._monitor is not None:
-            self._monitor.pause_metric_sampling("proposal execution")
-        try:
-            self._manager.tracker.reset()  # summaries are per execution
-            self._planner.clear()
-            self._planner.add_execution_proposals(proposals, strategy=strategy, urp=urp)
-            self._run_replica_movements()
-            self._run_leadership_movements()
-            summary = self._manager.tracker.summary()
-            stopped = self._stop_requested.is_set()
-            self._notifier(
-                "execution_stopped" if stopped else "execution_finished", summary
-            )
+        with TRACER.span(
+            "proposal-execution", kind="executor", numProposals=len(proposals)
+        ) as span, REGISTRY.histogram("Executor.execution-timer"):
+            self._notifier("execution_started", {"numProposals": len(proposals)})
             op_log(
-                "Execution %s: %s",
-                "stopped by user" if stopped else "finished", summary,
+                "Execution started: %d proposal(s), removed=%s demoted=%s",
+                len(proposals), sorted(removed_brokers or ()), sorted(demoted_brokers or ()),
             )
-            return {**summary, "stopped": stopped}
-        finally:
             if self._monitor is not None:
-                self._monitor.resume_metric_sampling()
-            with self._lock:
-                self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                self._monitor.pause_metric_sampling("proposal execution")
+            try:
+                self._manager.tracker.reset()  # summaries are per execution
+                self._planner.clear()
+                self._planner.add_execution_proposals(proposals, strategy=strategy, urp=urp)
+                self._run_replica_movements()
+                self._run_leadership_movements()
+                summary = self._manager.tracker.summary()
+                stopped = self._stop_requested.is_set()
+                span.attributes["stopped"] = stopped
+                self._notifier(
+                    "execution_stopped" if stopped else "execution_finished", summary
+                )
+                op_log(
+                    "Execution %s: %s",
+                    "stopped by user" if stopped else "finished", summary,
+                )
+                return {**summary, "stopped": stopped}
+            finally:
+                if self._monitor is not None:
+                    self._monitor.resume_metric_sampling()
+                with self._lock:
+                    self._state = ExecutorState.NO_TASK_IN_PROGRESS
 
     def _reap_finished(self, pending: List[ExecutionTask]) -> List[ExecutionTask]:
         """Poll the driver once and complete any finished tasks."""
@@ -218,6 +224,8 @@ class Executor:
         finish, so one slow movement never stalls unrelated brokers
         (the reference refills per poll round the same way)."""
         from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.tracing import TRACER
 
         with self._lock:
             self._state = ExecutorState.INTER_BROKER_REPLICA_MOVEMENT_TASK_IN_PROGRESS
@@ -225,40 +233,55 @@ class Executor:
             "Execution phase: inter-broker replica movement (%d task(s))",
             len(self._planner.remaining_inter_broker_replica_movements),
         )
-        in_flight: List[ExecutionTask] = []
-        polls = 0
-        while True:
-            in_flight = self._reap_finished(in_flight)
-            remaining = self._planner.remaining_inter_broker_replica_movements
-            if self._stop_requested.is_set():
-                if not in_flight:
-                    break  # graceful: nothing new once stop is requested
-            elif remaining:
-                brokers = set()
-                for t in remaining:
-                    brokers |= t.involved_brokers
-                slots = self._manager.available_slots(brokers)
-                batch = self._planner.get_inter_broker_replica_movement_tasks(slots)
-                if batch:
-                    now_ms = int(self._clock() * 1000)
-                    self._manager.mark_in_progress(batch, now_ms)
-                    for t in batch:
-                        self._driver.start_replica_movement(t)
-                    in_flight.extend(batch)
-            elif not in_flight:
-                break
-            if in_flight:
-                polls += 1
-                if polls > self._config.max_execution_polls:
-                    now_ms = int(self._clock() * 1000)
-                    for t in in_flight:
-                        t.kill(now_ms)
-                        self._manager.mark_done(t)
-                    raise TimeoutError(f"{len(in_flight)} execution task(s) never finished")
-                time.sleep(self._config.execution_progress_check_interval_s)
+        with TRACER.span(
+            "executor.replica-movement-phase", kind="executor",
+            tasks=len(self._planner.remaining_inter_broker_replica_movements),
+        ) as span:
+            batches = 0
+            in_flight: List[ExecutionTask] = []
+            polls = 0
+            while True:
+                in_flight = self._reap_finished(in_flight)
+                remaining = self._planner.remaining_inter_broker_replica_movements
+                if self._stop_requested.is_set():
+                    if not in_flight:
+                        break  # graceful: nothing new once stop is requested
+                elif remaining:
+                    brokers = set()
+                    for t in remaining:
+                        brokers |= t.involved_brokers
+                    slots = self._manager.available_slots(brokers)
+                    batch = self._planner.get_inter_broker_replica_movement_tasks(slots)
+                    if batch:
+                        # per-batch dispatch span: batch sizes and dispatch
+                        # latency are where throttling problems show first
+                        with TRACER.span(
+                            "executor.batch-dispatch", kind="executor",
+                            tasks=len(batch), type="replica",
+                        ), REGISTRY.histogram("Executor.batch-dispatch-timer"):
+                            now_ms = int(self._clock() * 1000)
+                            self._manager.mark_in_progress(batch, now_ms)
+                            for t in batch:
+                                self._driver.start_replica_movement(t)
+                        batches += 1
+                        in_flight.extend(batch)
+                elif not in_flight:
+                    break
+                if in_flight:
+                    polls += 1
+                    if polls > self._config.max_execution_polls:
+                        now_ms = int(self._clock() * 1000)
+                        for t in in_flight:
+                            t.kill(now_ms)
+                            self._manager.mark_done(t)
+                        raise TimeoutError(f"{len(in_flight)} execution task(s) never finished")
+                    time.sleep(self._config.execution_progress_check_interval_s)
+            span.attributes["batches"] = batches
 
     def _run_leadership_movements(self) -> None:
         from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.tracing import TRACER
 
         with self._lock:
             self._state = ExecutorState.LEADER_MOVEMENT_TASK_IN_PROGRESS
@@ -266,12 +289,20 @@ class Executor:
             "Execution phase: leadership movement (%d task(s))",
             len(self._planner.remaining_leadership_movements),
         )
-        while not self._stop_requested.is_set():
-            batch = self._planner.get_leadership_movement_tasks(self._manager.leadership_cap)
-            if not batch:
-                break
-            now_ms = int(self._clock() * 1000)
-            self._manager.mark_in_progress(batch, now_ms)
-            for t in batch:
-                self._driver.start_leadership_movement(t)
-            self._wait_for_tasks(batch)
+        with TRACER.span(
+            "executor.leadership-movement-phase", kind="executor",
+            tasks=len(self._planner.remaining_leadership_movements),
+        ):
+            while not self._stop_requested.is_set():
+                batch = self._planner.get_leadership_movement_tasks(self._manager.leadership_cap)
+                if not batch:
+                    break
+                with TRACER.span(
+                    "executor.batch-dispatch", kind="executor",
+                    tasks=len(batch), type="leadership",
+                ), REGISTRY.histogram("Executor.batch-dispatch-timer"):
+                    now_ms = int(self._clock() * 1000)
+                    self._manager.mark_in_progress(batch, now_ms)
+                    for t in batch:
+                        self._driver.start_leadership_movement(t)
+                self._wait_for_tasks(batch)

@@ -156,6 +156,16 @@ class CruiseControl:
     ) -> OptimizerResult:
         """Cached default-goal proposals, or a fresh optimization
         (KafkaCruiseControl.getProposals :710)."""
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("get-proposals", kind="facade", cache="miss") as span:
+            return self._get_proposals(
+                goal_names, requirements, options, ignore_proposal_cache, model, span
+            )
+
+    def _get_proposals(
+        self, goal_names, requirements, options, ignore_proposal_cache, model, span
+    ) -> OptimizerResult:
         req = requirements or self._config.default_requirements
         use_cache = not self._ignore_proposal_cache(goal_names, options, ignore_proposal_cache)
         if use_cache and model is None:
@@ -176,6 +186,7 @@ class CruiseControl:
                     and self._clock() - c.computed_at < self._config.proposal_expiration_s
                 )
                 if fresh:
+                    span.attributes["cache"] = "hit"
                     return c.result
 
         if model is None:

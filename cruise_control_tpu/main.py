@@ -35,7 +35,9 @@ def build_simulated_service(
     `config_path`: optional cruisecontrol.properties — the analyzer keys
     (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`
     and the bulk count-planner knobs) map onto the goal engine through
-    BalancingConstraint.from_config / OptimizerSettings.from_config."""
+    BalancingConstraint.from_config / OptimizerSettings.from_config, and the
+    `observability.*` keys configure the span tracer (ring size, JSONL sink)
+    and arm the one-shot profiler capture (docs/OBSERVABILITY.md)."""
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
     from cruise_control_tpu.async_ops import AsyncCruiseControl
     from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
@@ -90,6 +92,13 @@ def build_simulated_service(
             constraint=BalancingConstraint.from_config(cfg),
             settings=OptimizerSettings.from_config(cfg),
         )
+        from cruise_control_tpu.common import tracing
+
+        tracing.TRACER.configure(
+            ring_size=cfg.get_int("observability.trace.ring.size"),
+            jsonl_path=cfg.get_string("observability.trace.jsonl.path") or None,
+        )
+        tracing.set_profile_dir(cfg.get_string("observability.profile.dir") or None)
     facade = CruiseControl(
         monitor, executor, optimizer=optimizer,
         config=FacadeConfig(

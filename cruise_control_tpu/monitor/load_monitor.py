@@ -405,6 +405,13 @@ class LoadMonitor:
         The flattening pass of LoadMonitor.clusterModel (:422-487): topology
         arrays come straight from metadata; part_load comes from the window
         aggregation, leader/follower split via the CPU attribution model."""
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("cluster-model-creation", kind="monitor") as span:
+            model, meta = self._build_cluster_model(requirements, span)
+        return model, meta
+
+    def _build_cluster_model(self, requirements: ModelCompletenessRequirements, span):
         t0 = self._clock()
         topo = self._metadata.refresh_metadata()
         self._ensure_universe(topo)
@@ -469,8 +476,14 @@ class LoadMonitor:
         self.sensors["model_creation_time_s"] += self._clock() - t0
         from cruise_control_tpu.common.sensors import REGISTRY
 
-        REGISTRY.timer("LoadMonitor.cluster-model-creation-timer").record(
+        # hot timer -> histogram: /metrics serves p50/p95/p99 of model builds
+        REGISTRY.histogram("LoadMonitor.cluster-model-creation-timer").record(
             self._clock() - t0
+        )
+        span.attributes.update(
+            brokers=int(topo.num_brokers),
+            partitions=int(topo.num_partitions),
+            generation=int(self.generation),
         )
         return model, meta
 

@@ -5,7 +5,9 @@ Analog of KafkaCruiseControlServlet (cc/servlet/KafkaCruiseControlServlet.java:7
 cc/servlet/EndPoint.java:38-57:
 
   GET  state, load, partition_load, proposals, kafka_cluster_state,
-       user_tasks, review_board, bootstrap, train
+       user_tasks, review_board, bootstrap, train,
+       metrics, trace  (TPU-native observability; also at root /metrics and
+                        /trace — docs/OBSERVABILITY.md)
   POST rebalance, add_broker, remove_broker, demote_broker,
        stop_proposal_execution, pause_sampling, resume_sampling,
        topic_configuration, admin, review
@@ -347,6 +349,41 @@ class CruiseControlApp:
     async def user_tasks(self, request) -> web.Response:
         return self._json({"userTasks": self._tasks.describe_all(), "version": 1})
 
+    async def metrics(self, request) -> web.Response:
+        """Prometheus text exposition of the sensor registry (timers, meters,
+        histograms with p50/p95/p99 quantile gauges, numeric gauges) — the
+        scrape surface of docs/OBSERVABILITY.md; also mounted at `/metrics`
+        for stock Prometheus scrape configs."""
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        return web.Response(
+            body=REGISTRY.prometheus_text().encode("utf-8"),
+            headers={"Content-Type": "text/plain; version=0.0.4; charset=utf-8"},
+        )
+
+    async def trace(self, request) -> web.Response:
+        """Recent tracer spans (newest first) + per-kind latency summary.
+        `kind` filters by span kind (proposal/goal/device-call/monitor/
+        executor/detector), `trace_id` by trace, `limit` bounds the list."""
+        from cruise_control_tpu.common.tracing import TRACER
+
+        try:
+            limit = int(request.query.get("limit", "256"))
+        except ValueError:
+            return self._json({"errorMessage": "limit must be an integer"}, status=400)
+        return self._json(
+            {
+                "spans": TRACER.recent(
+                    limit=max(1, min(limit, 10_000)),
+                    kind=request.query.get("kind") or None,
+                    trace_id=request.query.get("trace_id") or None,
+                ),
+                "summary": TRACER.summarize(),
+                "overheadS": round(TRACER.overhead_s, 6),
+                "version": 1,
+            }
+        )
+
     async def review_board(self, request) -> web.Response:
         if self._purgatory is None:
             return self._json({"errorMessage": "2-step verification is disabled"}, status=400)
@@ -529,6 +566,7 @@ class CruiseControlApp:
             ("kafka_cluster_state", self.kafka_cluster_state),
             ("user_tasks", self.user_tasks), ("review_board", self.review_board),
             ("bootstrap", self.bootstrap), ("train", self.train),
+            ("metrics", self.metrics), ("trace", self.trace),
         ]
         p = [
             ("rebalance", self.rebalance), ("add_broker", self.add_broker),
@@ -542,6 +580,10 @@ class CruiseControlApp:
             app.router.add_get(f"{PREFIX}/{name}", handler)
         for name, handler in p:
             app.router.add_post(f"{PREFIX}/{name}", handler)
+        # root-level scrape aliases (registered BEFORE the web-UI catch-all so
+        # a mounted UI cannot shadow the Prometheus convention paths)
+        app.router.add_get("/metrics", self.metrics)
+        app.router.add_get("/trace", self.trace)
         if self._webui_dir:
             import os
 

@@ -1,0 +1,135 @@
+"""Dump a running instance's /metrics + /trace as a ranked latency table.
+
+The live counterpart of scripts/parse_xplane.py: where parse_xplane ranks
+XLA ops from a profiler capture, this ranks tracer span kinds and sensor
+histograms from a serving process — no profiler, no restart, one curl each.
+
+Usage:
+  python scripts/dump_metrics.py [http://127.0.0.1:9090] [--limit N] [--raw]
+
+Output (stdout):
+  1. per-span-kind latency table from /trace's summary, ranked by total time
+     (count, total, mean, p50/p95/p99, max),
+  2. the slowest recent spans with their attributes (engine, rounds, goal),
+  3. sensor histograms/timers from /metrics, ranked by total seconds.
+
+--raw additionally prints the raw Prometheus exposition text.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def _get(url: str, timeout: float = 10.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 1.0:
+        return f"{v:8.3f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:6.2f}ms"
+    return f"{v * 1e6:6.1f}us"
+
+
+def _span_kind_table(summary: dict) -> None:
+    print("== span kinds (ranked by total time) ==")
+    header = f"{'kind':<14} {'count':>7} {'total':>9} {'mean':>9} {'p50':>9} {'p95':>9} {'p99':>9} {'max':>9}"
+    print(header)
+    print("-" * len(header))
+    for kind, s in sorted(summary.items(), key=lambda kv: -kv[1]["totalS"]):
+        print(
+            f"{kind:<14} {s['count']:>7} {_fmt_s(s['totalS']):>9} "
+            f"{_fmt_s(s['meanS']):>9} {_fmt_s(s['p50S']):>9} "
+            f"{_fmt_s(s['p95S']):>9} {_fmt_s(s['p99S']):>9} {_fmt_s(s['maxS']):>9}"
+        )
+
+
+def _slow_spans(spans: list, top: int = 15) -> None:
+    print(f"\n== slowest recent spans (top {top}) ==")
+    timed = [s for s in spans if s.get("durationS") is not None]
+    for s in sorted(timed, key=lambda s: -s["durationS"])[:top]:
+        attrs = {
+            k: v for k, v in (s.get("attributes") or {}).items() if k != "synthetic"
+        }
+        attr_str = " ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+        print(
+            f"{_fmt_s(s['durationS']):>9}  {s['kind']:<12} {s['name']:<34} "
+            f"trace={s['traceId'][:8]} {attr_str}"
+        )
+
+
+def _parse_prometheus_latencies(text: str) -> dict:
+    """{sensor: {"count": n, "sum": s}} from the latency/timer families."""
+    out: dict = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        if name not in (
+            "cruise_control_latency_seconds_sum",
+            "cruise_control_latency_seconds_count",
+            "cruise_control_timer_seconds_sum",
+            "cruise_control_timer_seconds_count",
+        ):
+            continue
+        sensor = None
+        for part in labels_raw.split('",'):
+            k, _, v = part.partition('="')
+            if k.strip(", ") == "sensor":
+                sensor = v.rstrip('"')
+        if sensor is None:
+            continue
+        entry = out.setdefault(sensor, {"count": 0, "sum": 0.0})
+        if name.endswith("_sum"):
+            entry["sum"] = float(value)
+        else:
+            entry["count"] = int(float(value))
+    return out
+
+
+def _sensor_table(text: str) -> None:
+    latencies = _parse_prometheus_latencies(text)
+    print("\n== sensors (ranked by total seconds) ==")
+    header = f"{'sensor':<52} {'count':>8} {'total':>10} {'mean':>9}"
+    print(header)
+    print("-" * len(header))
+    for sensor, s in sorted(latencies.items(), key=lambda kv: -kv[1]["sum"]):
+        mean = s["sum"] / s["count"] if s["count"] else 0.0
+        print(f"{sensor:<52} {s['count']:>8} {_fmt_s(s['sum']):>10} {_fmt_s(mean):>9}")
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("base", nargs="?", default="http://127.0.0.1:9090")
+    parser.add_argument("--limit", type=int, default=512, help="spans to fetch")
+    parser.add_argument("--raw", action="store_true", help="also dump raw /metrics text")
+    args = parser.parse_args()
+    base = args.base.rstrip("/")
+
+    try:
+        trace = json.loads(_get(f"{base}/trace?limit={args.limit}"))
+        metrics_text = _get(f"{base}/metrics").decode()
+    except (urllib.error.URLError, OSError) as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 1
+
+    _span_kind_table(trace.get("summary", {}))
+    _slow_spans(trace.get("spans", []))
+    _sensor_table(metrics_text)
+    print(f"\ntracer overhead: {trace.get('overheadS', 0.0):.6f}s")
+    if args.raw:
+        print("\n== raw /metrics ==")
+        print(metrics_text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

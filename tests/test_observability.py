@@ -1,0 +1,535 @@
+"""Observability layer: histogram percentiles, tracer thread-safety,
+Prometheus exposition (/metrics), span serving (/trace), and the /state
+gauge-hardening regression.
+
+Compile-free on purpose: everything here is host-side (sensors, tracer,
+servlet), so the module adds no XLA programs to the suite's compile budget.
+The optimizer's span/histogram emission is exercised by every module that
+runs optimizations (test_optimizer/test_executor/test_rest)."""
+
+import json
+import re
+import threading
+
+import pytest
+from aiohttp import web
+
+from cruise_control_tpu.common.sensors import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    SensorRegistry,
+)
+from cruise_control_tpu.common.tracing import Tracer
+
+
+# -- Histogram -----------------------------------------------------------------
+
+
+def test_histogram_counts_and_totals():
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004, 10.0):
+        h.record(v)
+    s = h.snapshot()
+    assert s["count"] == 4
+    assert s["totalS"] == pytest.approx(10.007)
+    assert s["maxS"] == 10.0
+    assert s["lastS"] == 10.0
+
+
+def _bucket_bounds_around(value):
+    """(lo, hi] bucket of the default bounds that owns `value`."""
+    lo = 0.0
+    for b in DEFAULT_BUCKETS:
+        if value <= b:
+            return lo, b
+        lo = b
+    return lo, float("inf")
+
+
+def test_histogram_percentiles_land_in_owning_bucket():
+    h = Histogram()
+    # 90 fast ops at ~1ms, 10 slow at ~1s: p50 must sit in the 1ms bucket,
+    # p95/p99 in the 1s bucket
+    for _ in range(90):
+        h.record(0.001)
+    for _ in range(10):
+        h.record(1.0)
+    s = h.snapshot()
+    lo50, hi50 = _bucket_bounds_around(0.001)
+    assert lo50 < s["p50S"] <= hi50
+    lo95, hi95 = _bucket_bounds_around(1.0)
+    assert lo95 < s["p95S"] <= hi95
+    assert lo95 < s["p99S"] <= hi95
+    # interpolation never exceeds the observed max
+    assert s["p99S"] <= s["maxS"]
+
+
+def test_histogram_overflow_bucket_uses_max():
+    h = Histogram(bounds=(0.1, 1.0))
+    for _ in range(10):
+        h.record(50.0)  # all overflow
+    # overflow bucket interpolates between the last bound and the observed max
+    assert 1.0 < h.quantile(0.5) <= 50.0
+    assert h.quantile(1.0) == 50.0
+    cum = h.bucket_counts()
+    assert cum[-1] == (float("inf"), 10)
+    assert cum[-2] == (1.0, 0)
+
+
+def test_histogram_empty_and_negative():
+    h = Histogram()
+    assert h.snapshot()["p95S"] == 0.0
+    h.record(-5.0)  # clamped to 0, lands in the first bucket
+    assert h.snapshot()["count"] == 1
+    assert h.snapshot()["maxS"] == 0.0
+
+
+def test_histogram_context_manager():
+    h = Histogram()
+    with h:
+        pass
+    assert h.count == 1
+
+
+# -- Tracer --------------------------------------------------------------------
+
+
+def test_span_nesting_and_lineage():
+    tr = Tracer(ring_size=64)
+    with tr.span("parent", kind="a") as p:
+        assert tr.current() is p
+        assert tr.current_trace_id() == p.trace_id
+        with tr.span("child", kind="b") as c:
+            assert c.trace_id == p.trace_id
+            assert c.parent_id == p.span_id
+        tr.add_attributes(marked=True)
+    assert tr.current() is None
+    spans = tr.recent()
+    assert [s["name"] for s in spans] == ["parent", "child"]  # newest first
+    # add_attributes after the child closed targets the (still open) parent
+    assert spans[0]["attributes"] == {"marked": True}
+    assert spans[1]["attributes"] == {}
+    assert spans[0]["durationS"] is not None
+
+
+def test_span_error_recorded_and_reraised():
+    tr = Tracer(ring_size=8)
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("no")
+    assert tr.recent()[0]["error"] == "ValueError: no"
+    assert tr.current() is None
+
+
+def test_synthetic_record_span_inherits_lineage():
+    tr = Tracer(ring_size=8)
+    with tr.span("root") as root:
+        tr.record_span("goal:X", kind="goal", duration_s=1.5, rounds=7)
+    spans = {s["name"]: s for s in tr.recent()}
+    g = spans["goal:X"]
+    assert g["traceId"] == root.trace_id
+    assert g["parentId"] == root.span_id
+    assert g["durationS"] == 1.5
+    assert g["attributes"]["rounds"] == 7
+    assert g["attributes"]["synthetic"] is True
+
+
+def test_tracer_thread_safety_under_concurrent_spans():
+    tr = Tracer(ring_size=10_000)
+    n_threads, per_thread = 8, 100
+    errors = []
+
+    def work(t):
+        try:
+            for i in range(per_thread):
+                with tr.span(f"outer-{t}-{i}", kind="outer") as o:
+                    with tr.span(f"inner-{t}-{i}", kind="inner") as inner:
+                        assert inner.trace_id == o.trace_id
+                        assert inner.parent_id == o.span_id
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(n_threads)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    spans = tr.recent(limit=10_000)
+    assert len(spans) == n_threads * per_thread * 2
+    # span ids unique; every inner's parent is its own thread's outer
+    by_id = {s["spanId"]: s for s in spans}
+    assert len(by_id) == len(spans)
+    for s in spans:
+        if s["kind"] == "inner":
+            parent = by_id[s["parentId"]]
+            assert parent["traceId"] == s["traceId"]
+            t = s["name"].split("-")[1]
+            assert parent["name"].split("-")[1] == t
+    assert tr.spans_recorded == len(spans)
+    assert tr.overhead_s > 0.0
+
+
+def test_tracer_ring_is_bounded_and_configurable():
+    tr = Tracer(ring_size=16)
+    for i in range(100):
+        tr.record_span(f"s{i}", kind="k", duration_s=0.0)
+    assert len(tr.recent(limit=1000)) == 16
+    assert tr.recent(limit=1000)[0]["name"] == "s99"
+    tr.configure(ring_size=32)
+    assert tr.ring_size == 32
+    assert len(tr.recent(limit=1000)) == 16  # retained across resize
+
+
+def test_tracer_jsonl_sink(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(ring_size=8, jsonl_path=str(path))
+    with tr.span("a", kind="x", n=1):
+        pass
+    tr.record_span("b", kind="y", duration_s=0.5)
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [l["name"] for l in lines] == ["a", "b"]
+    assert lines[0]["attributes"] == {"n": 1}
+
+
+def test_op_log_carries_trace_id(caplog):
+    import logging
+
+    from cruise_control_tpu.common.oplog import op_log
+    from cruise_control_tpu.common.tracing import TRACER
+
+    with caplog.at_level(logging.INFO, logger="operationLogger"):
+        with TRACER.span("op", kind="executor") as sp:
+            op_log("Execution started: %d proposal(s)", 3)
+        op_log("outside any span")
+    assert f"Execution started: 3 proposal(s) [trace={sp.trace_id}]" in caplog.text
+    assert "outside any span [trace=" not in caplog.text
+
+
+# -- Prometheus exposition -----------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^{}]*)\})? "
+    r"(?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|Inf|NaN))$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"(?:,|$)')
+
+
+def _parse_prometheus(text: str):
+    """Strict-enough 0.0.4 parser: returns (types, samples) and raises on any
+    malformed line. samples = [(family, labels_dict, value)]."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) == 4, line
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram", "summary"), line
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment line: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        samples.append((m.group("name"), labels, m.group("value")))
+    return types, samples
+
+
+def _family(name: str) -> str:
+    for suffix in ("_bucket", "_count", "_sum", "_max"):
+        if name.endswith(suffix):
+            return name[: -len(suffix)]
+    return name
+
+
+def test_prometheus_text_parses_and_types_cover_samples():
+    reg = SensorRegistry()
+    reg.timer("T.timer").record(0.5)
+    reg.meter("M.meter").mark(3)
+    h = reg.histogram("GoalOptimizer.optimizer-round-timer")
+    for v in (0.01, 0.02, 0.2, 2.0):
+        h.record(v)
+    reg.gauge("G.num", lambda: 42)
+    reg.gauge("G.dict", lambda: {"hits": 7, "misses": 1})
+    reg.gauge("G.str", lambda: "not-numeric")  # /state-only, must be skipped
+    text = reg.prometheus_text()
+    types, samples = _parse_prometheus(text)
+    # every sample belongs to a declared family
+    for name, labels, _value in samples:
+        assert _family(name) in types, f"sample {name} missing TYPE"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    # timer summary
+    t = dict_of(by_name["cruise_control_timer_seconds_count"])["T.timer"]
+    assert float(t) == 1
+    # meter counter
+    m = dict_of(by_name["cruise_control_meter_total"])["M.meter"]
+    assert float(m) == 3
+    # histogram: cumulative buckets ending at +Inf == count, quantiles present
+    buckets = [
+        (labels, float(v))
+        for labels, v in by_name["cruise_control_latency_seconds_bucket"]
+        if labels["sensor"] == "GoalOptimizer.optimizer-round-timer"
+    ]
+    assert buckets[-1][0]["le"] == "+Inf" and buckets[-1][1] == 4
+    cums = [v for _, v in buckets]
+    assert cums == sorted(cums), "bucket counts must be cumulative"
+    quantiles = {
+        labels["quantile"]
+        for labels, _ in by_name["cruise_control_latency_quantile_seconds"]
+        if labels["sensor"] == "GoalOptimizer.optimizer-round-timer"
+    }
+    assert quantiles == {"0.5", "0.95", "0.99"}
+    # gauges: numeric + flattened dict, string gauge absent
+    gauge_sensors = {labels["sensor"] for labels, _ in by_name["cruise_control_gauge"]}
+    assert "G.num" in gauge_sensors and "G.dict" in gauge_sensors
+    assert "G.str" not in gauge_sensors
+    fields = {
+        labels.get("field")
+        for labels, _ in by_name["cruise_control_gauge"]
+        if labels["sensor"] == "G.dict"
+    }
+    assert fields == {"hits", "misses"}
+
+
+def dict_of(pairs):
+    return {labels["sensor"]: value for labels, value in pairs}
+
+
+def test_prometheus_label_escaping():
+    reg = SensorRegistry()
+    weird = 'we"ird\\name\nwith-all-three'
+    reg.meter(weird).mark()
+    text = reg.prometheus_text()
+    types, samples = _parse_prometheus(text)  # escaped value must still parse
+    [(name, labels, value)] = [s for s in samples if s[0] == "cruise_control_meter_total"]
+    assert labels["sensor"] == 'we\\"ird\\\\name\\nwith-all-three'
+    raw = [l for l in text.splitlines() if l.startswith("cruise_control_meter_total")][0]
+    assert '\n' not in raw[len("cruise_control_meter_total"):]
+
+
+def test_snapshot_isolates_raising_gauge():
+    reg = SensorRegistry()
+    reg.timer("ok.timer").record(1.0)
+    reg.gauge("good", lambda: 5)
+    reg.gauge("bad", lambda: 1 / 0)
+    snap = reg.snapshot()
+    assert snap["good"] == 5
+    assert snap["ok.timer"]["count"] == 1
+    assert snap["bad"] == {"error": "ZeroDivisionError: division by zero"}
+    # and /metrics skips it without dying
+    types, samples = _parse_prometheus(reg.prometheus_text())
+    assert all(labels.get("sensor") != "bad" for _, labels, _ in samples)
+
+
+# -- servlet endpoints over a live server --------------------------------------
+
+
+@pytest.fixture(scope="module")
+def server():
+    """Minimal full-stack server (no optimizations triggered => no XLA
+    compiles); reuses the test_rest wiring pattern."""
+    import asyncio
+    import socket
+
+    from cruise_control_tpu.async_ops import AsyncCruiseControl
+    from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
+    from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+    from cruise_control_tpu.facade import CruiseControl, FacadeConfig
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+    from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+    from cruise_control_tpu.reporter.transport import InMemoryTransport
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+    from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+    truth = random_cluster(
+        7, ClusterProperty(num_racks=2, num_brokers=4, num_topics=3, replication_factor=2)
+    )
+    sim = SimulatedCluster(truth)
+    transport = InMemoryTransport()
+    clock = {"now": 0.0}
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3, min_samples_per_window=1),
+        clock=lambda: clock["now"],
+    )
+    monitor.start_up()
+    for r in range(3):
+        transport.publish(sim.all_metrics(r * 1000 + 500))
+        clock["now"] = r + 0.8
+        monitor.sample_once()
+    executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor,
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    acc = AsyncCruiseControl(facade)
+    detector = AnomalyDetector(facade, notifier=SelfHealingNotifier(),
+                               clock=lambda: clock["now"])
+    app = CruiseControlApp(acc, anomaly_detector=detector, response_wait_s=0.2)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    yield {"url": f"http://127.0.0.1:{port}", "facade": facade, "monitor": monitor}
+    loop.call_soon_threadsafe(loop.stop)
+    th.join(timeout=5)
+    acc.shutdown()
+
+
+def _http_get(url: str):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers, resp.read()
+
+
+def test_metrics_endpoint_serves_prometheus(server):
+    # a model build populates the cluster-model-creation histogram
+    server["monitor"].cluster_model()
+    for path in ("/metrics", "/kafkacruisecontrol/metrics"):
+        status, headers, body = _http_get(server["url"] + path)
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "version=0.0.4" in headers["Content-Type"]
+        types, samples = _parse_prometheus(body.decode())
+        assert types["cruise_control_latency_seconds"] == "histogram"
+        sensors = {labels.get("sensor") for _, labels, _ in samples}
+        assert "LoadMonitor.cluster-model-creation-timer" in sensors
+
+
+def test_trace_endpoint_shape_and_filters(server):
+    server["monitor"].cluster_model()  # at least one monitor span
+    status, _, body = _http_get(server["url"] + "/trace?limit=50")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["version"] == 1
+    assert isinstance(payload["overheadS"], float)
+    assert payload["spans"], "expected at least one span"
+    span = payload["spans"][0]
+    assert {"name", "kind", "traceId", "spanId", "parentId", "startUnixS",
+            "durationS", "attributes", "error"} <= set(span)
+    assert "monitor" in payload["summary"]
+    assert {"count", "totalS", "p50S", "p95S", "p99S"} <= set(payload["summary"]["monitor"])
+    # kind filter
+    status, _, body = _http_get(server["url"] + "/trace?kind=monitor&limit=5")
+    filtered = json.loads(body)["spans"]
+    assert filtered and all(s["kind"] == "monitor" for s in filtered)
+    # trace_id filter follows a specific trace
+    tid = filtered[0]["traceId"]
+    status, _, body = _http_get(server["url"] + f"/trace?trace_id={tid}&limit=50")
+    assert all(s["traceId"] == tid for s in json.loads(body)["spans"])
+    # bad limit is a 400, not a 500
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _http_get(server["url"] + "/trace?limit=nope")
+    assert err.value.code == 400
+
+
+def test_state_survives_raising_gauge(server):
+    from cruise_control_tpu.common.sensors import REGISTRY
+
+    REGISTRY.gauge("test.raising-gauge", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    try:
+        status, _, body = _http_get(server["url"] + "/kafkacruisecontrol/state")
+        assert status == 200
+        sensors = json.loads(body)["Sensors"]
+        assert sensors["test.raising-gauge"] == {"error": "RuntimeError: boom"}
+        # the rest of the block is intact
+        assert "Tracer.spans-recorded" in sensors
+    finally:
+        REGISTRY._gauges.pop("test.raising-gauge", None)
+
+
+def test_detector_sweep_emits_span(server):
+    """Stub detectors: the real GoalViolationDetector dry-runs the anomaly
+    goal stack (an XLA compile this module deliberately avoids); span
+    emission is what's under test here."""
+    from cruise_control_tpu.common.tracing import TRACER
+    from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
+
+    class _Quiet:
+        def detect(self):
+            return None
+
+    class _QuietList:
+        def detect(self):
+            return []
+
+    det = AnomalyDetector(
+        server["facade"], notifier=SelfHealingNotifier(),
+        goal_violation_detector=_Quiet(), broker_failure_detector=_Quiet(),
+        metric_anomaly_detector=_QuietList(),
+    )
+    det.detect_once()
+    sweeps = [
+        s for s in TRACER.recent(limit=20, kind="detector")
+        if s["name"] == "anomaly-sweep"
+    ]
+    assert sweeps
+    assert sweeps[0]["attributes"]["anomalies"] == 0
+
+
+# -- config plumbing -----------------------------------------------------------
+
+
+def test_observability_config_keys_reach_tracer(tmp_path):
+    from cruise_control_tpu.common.tracing import TRACER
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({})
+    assert cfg.get_int("observability.trace.ring.size") == 4096
+    assert cfg.get_string("observability.trace.jsonl.path") == ""
+    assert cfg.get_string("observability.profile.dir") == ""
+
+    jsonl = tmp_path / "trace.jsonl"
+    props = tmp_path / "cc.properties"
+    props.write_text(
+        "observability.trace.ring.size=128\n"
+        f"observability.trace.jsonl.path={jsonl}\n"
+    )
+    old_ring, old_path = TRACER.ring_size, TRACER._jsonl_path
+    try:
+        from cruise_control_tpu.main import build_simulated_service
+
+        build_simulated_service(
+            num_brokers=4, num_racks=2, num_topics=3, config_path=str(props)
+        )
+        assert TRACER.ring_size == 128
+        with TRACER.span("cfg-roundtrip"):
+            pass
+        assert jsonl.exists()
+        assert any(
+            json.loads(l)["name"] == "cfg-roundtrip"
+            for l in jsonl.read_text().splitlines()
+        )
+    finally:
+        TRACER.configure(ring_size=old_ring, jsonl_path=old_path)

@@ -301,6 +301,32 @@ def _default_options():
     return OptimizationOptions()
 
 
+def _compile_counters() -> dict:
+    """Process-wide compile/program-cache counters (sensors from the
+    optimizer's program cache): the raw material of the compile-amortization
+    summary and each config's `bucketed` detail block."""
+    from cruise_control_tpu.common.sensors import REGISTRY
+
+    h = REGISTRY.histogram("GoalOptimizer.stack-compile-timer").snapshot()
+    return {
+        "programs": h["count"],
+        "compileS": round(h["totalS"], 3),
+        "misses": REGISTRY.meter("GoalOptimizer.program-cache-misses").snapshot()["count"],
+        "hits": REGISTRY.meter("GoalOptimizer.program-cache-hits").snapshot()["count"],
+    }
+
+
+def _bucketed_block(result, before: dict) -> dict:
+    """Shape-bucketing record for the detail file: exact vs padded dims and
+    how many compiles this config actually paid vs reused warm."""
+    after = _compile_counters()
+    block = dict(result.bucketed or {})
+    block["newPrograms"] = after["programs"] - before["programs"]
+    block["compileS"] = round(after["compileS"] - before["compileS"], 3)
+    block["warmReuses"] = after["hits"] - before["hits"]
+    return block
+
+
 def _parity_block(cfg_id, batched_result, greedy_wall, greedy_result):
     """Side-by-side scores: batched must not violate more than the greedy
     AND may not regress any goal's final cost beyond epsilon (the north
@@ -388,6 +414,7 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
     from cruise_control_tpu.common.resources import BrokerState
     from cruise_control_tpu.models.generators import BASELINE_CONFIGS, random_cluster
 
+    compile0 = _compile_counters()
     t_build = time.monotonic()
     model = random_cluster(seed, BASELINE_CONFIGS[cfg_id])
     log(
@@ -439,7 +466,13 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         payload.update(_goal_payload_fields(add_result))
         obs = _observability_block(add_result, add_wall)
         payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
-        detail = {"goals": _goal_table(add_result), "observability": obs}
+        detail = {
+            "goals": _goal_table(add_result),
+            "observability": obs,
+            "bucketed": _bucketed_block(add_result, compile0),
+        }
+        payload["programsCompiled"] = _compile_counters()["programs"]
+        payload["compileSTotal"] = _compile_counters()["compileS"]
         if parity:
             greedy = GoalOptimizer(settings=_settings(batched=False))
             greedy_wall, greedy_result = _timed(
@@ -493,7 +526,10 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         "goals": _goal_table(result),
         "violatedAfter": result.violated_goals_after,
         "observability": obs,
+        "bucketed": _bucketed_block(result, compile0),
     }
+    payload["programsCompiled"] = _compile_counters()["programs"]
+    payload["compileSTotal"] = _compile_counters()["compileS"]
     if cfg_id == 5:
         payload["vs_baseline"] = round(TARGET_S / wall, 3)
         if parity:
@@ -606,6 +642,15 @@ def main() -> None:
         except Exception:
             log(f"[config {cfg_id}] FAILED:\n{traceback.format_exc()}")
             break
+    # one-line compile-amortization summary: the shape-bucketed program
+    # cache's whole point is FEWER programs than configs — record the win in
+    # the trajectory without reading the detail JSON
+    cc = _compile_counters()
+    log(
+        f"compile-amortization: {cc['programs']} programs compiled "
+        f"({cc['compileS']:.1f}s total XLA) for {completed} configs run; "
+        f"{cc['hits']} warm program reuses, {cc['misses']} cold misses"
+    )
     if completed == 0:
         # still emit a parsable line so the driver records the failure mode
         emit(

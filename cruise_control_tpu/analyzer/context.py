@@ -116,6 +116,15 @@ class StaticCtx(NamedTuple):
     #: partitions whose replicas may move
     movable_partition: jax.Array  # bool[P]
     host_cpu_capacity_limit: jax.Array  # f32[H]
+    #: REAL brokers (False = shape-bucket padding). Padding brokers are
+    #: neither `alive` nor `dead` — invisible to every goal window, never a
+    #: destination, never an evacuation source (docs/OPTIMIZER.md mask
+    #: invariants).
+    broker_valid: jax.Array  # bool[B]
+    #: count of REAL partitions (shape-bucket padding excluded) — the
+    #: denominator for any per-partition mean (a padded axis length would
+    #: drift with the bucket and change results vs the exact shape)
+    num_valid_partitions: jax.Array  # f32[]
     # constraint thresholds (from BalancingConstraint)
     resource_balance_pct: jax.Array  # f32[4]
     low_utilization_threshold: jax.Array  # f32[4]
@@ -171,11 +180,20 @@ def build_static_ctx(
     constraint: BalancingConstraint,
     dims: Dims,
     options: OptimizationOptions = OptimizationOptions(),
+    valid_brokers: Optional[int] = None,
+    valid_partitions: Optional[int] = None,
 ) -> StaticCtx:
+    """`valid_brokers`/`valid_partitions`: count of REAL rows when the model
+    was padded to a shape bucket (padding is appended, so a prefix count
+    suffices); None = every row is real (unpadded models)."""
     b = dims.num_brokers
     state = jnp.asarray(model.broker_state)
-    alive = state != BrokerState.DEAD
-    demoted = state == BrokerState.DEMOTED
+    valid = jnp.arange(b) < (b if valid_brokers is None else valid_brokers)
+    # padding brokers are neither alive nor dead: every goal window averages
+    # over `alive`, and evacuation/self-healing triggers on `dead` — a
+    # padded broker must never enter either set
+    alive = (state != BrokerState.DEAD) & valid
+    demoted = (state == BrokerState.DEMOTED) & valid
 
     def mask_or(arr, default):
         if arr is None:
@@ -217,13 +235,17 @@ def build_static_ctx(
         broker_host=jnp.asarray(model.broker_host),
         broker_state=state,
         alive=alive,
-        dead=~alive,
-        new=state == BrokerState.NEW,
+        dead=(state == BrokerState.DEAD) & valid,
+        new=(state == BrokerState.NEW) & valid,
         demoted=demoted,
         replica_dst_ok=replica_dst_ok,
         leadership_dst_ok=leadership_dst_ok,
         movable_partition=movable,
         host_cpu_capacity_limit=host_cpu_cap * cap_threshold[Resource.CPU],
+        broker_valid=valid,
+        num_valid_partitions=jnp.float32(
+            dims.num_partitions if valid_partitions is None else valid_partitions
+        ),
         resource_balance_pct=jnp.asarray(effective.resource_balance_percentage),
         low_utilization_threshold=jnp.asarray(effective.low_utilization_threshold),
         replica_balance_pct=jnp.float32(effective.replica_balance_percentage),

@@ -151,23 +151,32 @@ def table_demoted_pref(static: StaticCtx, gs, agg: Aggregates, goal, tables):
 
 def rack_diverse_cold(static: StaticCtx, gs, agg: Aggregates, goal, tables,
                       dims, c: int) -> jax.Array:
-    """i32[C]: global destination list — the best eligible broker of each of
-    the top racks (so RackAwareGoal always finds an eligible rack), padded to
-    C with the globally best-preferred brokers (duplicates are harmless; the
-    waves' disjointness keeps at most one action per broker anyway)."""
+    """i32[C]: global destination list — the best eligible broker of each
+    NON-EMPTY rack first (so RackAwareGoal always finds an eligible rack),
+    then the globally best-preferred brokers (duplicates are harmless; the
+    waves' disjointness keeps at most one action per broker anyway).
+
+    One combined top-k over [rack-best entries (boosted), all brokers]
+    instead of separate per-rack and global passes: the list CONTENT is then
+    independent of how many EMPTY racks the rack axis carries — a padded
+    rack (shape bucketing) contributes a -inf entry that sorts after every
+    real broker, so bucketed and exact runs nominate identical destinations
+    (the padding-equivalence contract, docs/OPTIMIZER.md)."""
     pref = table_demoted_pref(static, gs, agg, goal, tables)
     nr = dims.num_racks
     rack_mask = static.broker_rack[None, :] == jnp.arange(nr)[:, None]  # [NR, B]
     per_rack = jnp.where(rack_mask, pref[None, :], -jnp.inf)
     best_broker = jnp.argmax(per_rack, axis=1).astype(jnp.int32)  # [NR]
     best_val = jnp.max(per_rack, axis=1)
-    k_rack = min(c, nr)
-    _, rack_idx = jax.lax.top_k(best_val, k_rack)
-    head = best_broker[rack_idx]
-    if c > k_rack:
-        _, tail = jax.lax.top_k(pref, c - k_rack)
-        head = jnp.concatenate([head, tail.astype(jnp.int32)])
-    return head
+    # rack representatives outrank every plain broker entry; empty racks
+    # stay at -inf and lose to every real broker
+    span = 2.0 + jnp.max(jnp.abs(jnp.where(jnp.isfinite(pref), pref, 0.0)))
+    combined = jnp.concatenate(
+        [jnp.where(jnp.isfinite(best_val), best_val + 2.0 * span, -jnp.inf), pref]
+    )
+    _, idx = jax.lax.top_k(combined, min(c, nr + pref.shape[0]))
+    idx = idx.astype(jnp.int32)
+    return jnp.where(idx < nr, best_broker[jnp.minimum(idx, nr - 1)], idx - nr)
 
 
 def select_surplus_pairs(static: StaticCtx, agg: Aggregates, tables, gs,
@@ -278,10 +287,19 @@ def topic_dst_list(static: StaticCtx, agg: Aggregates, tables, gs,
         -cnt_rows + jnp.where(band_room, 1e3, 0.0)[None, :],
         -jnp.inf,
     )
+    # per-row modular ROTATION of the tie-break ramp: near-tied rows then
+    # prefer staggered destinations (a hash here lets rows collide on the
+    # same broker and the waves' disjointness serializes them — measured 3-4x
+    # more topic rounds at the 520-broker scale). The wrap runs over the
+    # VALID broker count, not the axis length: the ramp value of a given
+    # real broker must not depend on how much shape-bucket padding the axis
+    # carries (padding-equivalence contract; padded brokers' d_pref is -inf,
+    # so their ramp values are inert).
+    n_valid = jnp.maximum(jnp.sum(static.broker_valid.astype(jnp.int32)), 1)
     b_all = jnp.arange(b_count, dtype=jnp.int32)
     jit_d = (
-        (b_all[None, :] + pair_b[:, None] * 151 + rnd * 977) % b_count
-    ).astype(jnp.float32) / b_count
+        (b_all[None, :] + pair_b[:, None] * 151 + rnd * 977) % n_valid
+    ).astype(jnp.float32) / n_valid.astype(jnp.float32)
     _, dst_list = jax.lax.top_k(d_pref + 1e-4 * jit_d, c_dst)  # [V, C]
     return dst_list.astype(jnp.int32)
 
@@ -887,7 +905,12 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
     k = max(1, min(k_rep, p_count))
     c = max(1, min(c_dst, dims.num_brokers))
     use_leadership = goal.uses_leadership and r >= 2
-    j_lead = max(1, min(v, p_count * (r - 1))) if use_leadership else 0
+    # clamped by the configured width and the promotion-grid size, NOT by the
+    # broker count: a broker-count clamp would let a shape-bucketed run
+    # shortlist more real promotions than the exact-shape run (extra top-k
+    # slots on the PARTITION axis only ever pick up -inf padding entries,
+    # which stay inert — extra slots on the broker axis pick up real ones)
+    j_lead = max(1, min(n_src, p_count * (r - 1))) if use_leadership else 0
 
     def drain_round(static: StaticCtx, agg: Aggregates, tables, gs, contrib,
                     rnd=None):

@@ -508,9 +508,12 @@ class LeaderBytesInDistributionGoal(Goal):
         from cruise_control_tpu.common.resources import PartMetric
 
         lnw = agg.leader_nw_in
-        p_count = static.part_load.shape[0]
+        # mean over REAL partitions: the padded axis length would shrink the
+        # unit with the shape bucket and change the planner's wave budget vs
+        # the exact-shape run (padding rows carry zero load, so only the
+        # denominator needs care)
         mean_w = jnp.sum(static.part_load[:, PartMetric.NW_IN_LEADER]) / jnp.maximum(
-            1.0, jnp.float32(p_count)
+            1.0, static.num_valid_partitions
         )
         unit = jnp.maximum(mean_w, 1e-6)
         surplus = jnp.where(

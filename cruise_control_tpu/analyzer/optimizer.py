@@ -128,8 +128,27 @@ class OptimizerSettings:
     swaps_per_broker: int = 4
     #: pad the partition and topic axes to coarse buckets so count churn
     #: (partition/topic create/delete) reuses compiled goal steps instead of
-    #: recompiling; broker churn still recompiles (rare in practice)
+    #: recompiling
     bucket_partitions: bool = True
+    #: pad the broker/host/rack axes up the geometric bucket ladder
+    #: (parallel.sharding.geom_bucket) so broker churn — an add/remove, a
+    #: partition-count drift regenerating the model with new Dims — reuses
+    #: the warm compiled program of the shared bucket instead of recompiling
+    #: the whole stack. Padding brokers are INVALID (zero capacity, neither
+    #: alive nor dead; StaticCtx.broker_valid): they can never receive
+    #: replicas, never rank as sources, and never enter a goal window, so a
+    #: bucketed run is result-identical to the exact shape
+    #: (tests/test_bucketing.py padding-equivalence contract).
+    bucket_brokers: bool = True
+    #: geometric step of the broker/host/rack bucket ladder (1.25 = quarter-
+    #: octave rungs, worst-case 25% padding). The partition/topic ladder
+    #: keeps its finer 1.125 steps (partition churn is higher-frequency and
+    #: the padded rows cost memory at 200k-partition scale).
+    bucket_ratio: float = 1.25
+    #: broker counts at or below this stay EXACT (tiny fixtures pay no
+    #: padding; the sub-floor regime is also where padded vs exact candidate
+    #: grid widths could diverge — see docs/OPTIMIZER.md)
+    bucket_floor: int = 64
     #: > 0: execute via the chunked goal machine — many short device calls of
     #: at most this many rounds each — instead of the single fused-stack call.
     #: Same kernels, same results; bounds each device call's duration, which
@@ -212,6 +231,10 @@ class OptimizerSettings:
             bulk_waves=config.get_int("optimizer.bulk.count.waves"),
             bulk_min_brokers=config.get_int("optimizer.bulk.min.brokers"),
             polish_rounds=config.get_int("optimizer.polish.rounds"),
+            bucket_partitions=config.get_boolean("optimizer.bucket.partitions"),
+            bucket_brokers=config.get_boolean("optimizer.bucket.brokers"),
+            bucket_ratio=config.get_double("optimizer.bucket.ratio"),
+            bucket_floor=config.get_int("optimizer.bucket.floor"),
         )
 
 
@@ -276,7 +299,13 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
     best_broker = jnp.argmax(per_rack, axis=1).astype(jnp.int32)  # [NR]
     best_val = jnp.max(per_rack, axis=1)
     vals, rack_idx = jax.lax.top_k(best_val, min(k, nr))
-    return best_broker[rack_idx]
+    cands = best_broker[rack_idx]
+    # EMPTY racks (no eligible broker — shape-bucket padding, or a fully
+    # excluded rack) would argmax to broker 0, injecting a destination the
+    # exact-shape run never considers; duplicate the best rack's candidate
+    # instead — a duplicate column scores identically and argmax resolves to
+    # the first occurrence, so it is inert in the grid
+    return jnp.where(jnp.isfinite(vals), cands, cands[0])
 
 
 # concrete-action materialization lives in actions.build_selected (shared
@@ -395,7 +424,14 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 # entries off their preferred destinations
                 valid_e = ~done & jnp.isfinite(top_scores) & (sel_kind == KIND_MOVE)
                 r = jnp.cumsum(valid_e.astype(jnp.int32)) - 1
-                paired = dst_rank[(r + w) % dims.num_brokers]
+                # wrap over the FEASIBLE prefix (rank_paired_destinations
+                # convention), not the broker-axis length: the axis may carry
+                # shape-bucket padding, and a length-dependent wrap would
+                # pair entries differently than the exact-shape run
+                n_feasible = jnp.maximum(
+                    jnp.sum(jnp.isfinite(pref)).astype(jnp.int32), 1
+                )
+                paired = dst_rank[(r + w) % n_feasible]
                 # leadership "dst" is wherever slot's replica lives NOW
                 fresh_dst = jnp.where(sel_kind == KIND_MOVE, paired, lead_dst(agg_c))
             else:
@@ -526,10 +562,14 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # hot/cold set width scales with broker count: selection staleness
         # within a round only hurts when the hot set is a large fraction of
         # the cluster (a 32-of-100 hot set measurably degraded quality; at
-        # 2,600 brokers a 128-wide set is 5% of the cluster).
-        adaptive = max(
-            settings.num_swap_pairs, min(128, dims.num_brokers // 16)
-        )
+        # 2,600 brokers a 128-wide set is 5% of the cluster). Rounded to the
+        # next power of two so broker counts inside one shape bucket (and a
+        # bucketed run vs its exact shape) derive the same width — the width
+        # sets the candidate-set SIZE, and extra width slots pick up real
+        # brokers, not inert padding.
+        width = dims.num_brokers // 16
+        width = 1 << max(0, width - 1).bit_length() if width > 1 else width
+        adaptive = max(settings.num_swap_pairs, min(128, width))
         swap_fn = make_swap_round(
             goal, (), dims, adaptive, settings.swap_candidates,
             settings.swaps_per_broker, apply_waves=settings.apply_waves,
@@ -791,7 +831,9 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
         )
         return agg, metrics
 
-    return jax.jit(stack_step)
+    # the input aggregates are dead after the call (the caller rebinds to the
+    # output); donating lets XLA write the final state over them in place
+    return jax.jit(stack_step, donate_argnums=(1,))
 
 
 #: Cache sizes are a hard resource bound, not just a speed knob: every
@@ -827,7 +869,7 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     ~total_rounds/budget calls.
 
     Returns machine(static, agg, tables, goal_idx, rounds_in_goal,
-    empties_in_goal, metrics, budget) -> (agg2, tables2, goal_idx2,
+    empties_in_goal, metrics, budget, enabled) -> (agg2, tables2, goal_idx2,
     rounds_in_goal2, empties_in_goal2, metrics2, spent) where `metrics` is a
     StackMetrics of [G] arrays updated in place (entry stats written when a
     goal starts, exit stats whenever it pauses or completes) and `spent` is
@@ -838,6 +880,20 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     stack is finished when goal_idx2 == len(goal_names). Compile cost matches
     the fused stack: all goal bodies are traced once into the one switch
     program.
+
+    `enabled` (traced bool[G]) masks goals at RUNTIME: a disabled goal's
+    cursor position advances in one step with zero rounds, no table
+    contribution, and untouched metrics — running an enabled subset through
+    the full-stack program is bit-identical to a program traced for the
+    subset alone (goals only interact through the tables, and a disabled
+    goal contributes nothing). This is what lets every requested subset of
+    the default stack share ONE compiled machine per shape bucket: the
+    compile-program cache keys on the full goal list, and a request for
+    ["RackAwareGoal", "ReplicaCapacityGoal"] rides the same warm executable
+    as the full stack. `agg`, `tables`, and `metrics` are DONATED: the
+    chunked driver threads them through repeated calls, and at 200k-
+    partition scale the un-donated copies of Aggregates (assignment +
+    per-broker tables) per chunk were the dominant steady-state allocation.
     """
     from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
 
@@ -856,7 +912,8 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     n_phases = 2 * n_goals if settings.polish_rounds > 0 else n_goals
 
     def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx,
-                rounds_in_goal, empties_in_goal, metrics: StackMetrics, budget):
+                rounds_in_goal, empties_in_goal, metrics: StackMetrics, budget,
+                enabled):
         def make_branch(goal, loop):
             def branch(op):
                 agg_b, tables_b, gi, rig, emp, metrics_b, left = op
@@ -979,11 +1036,24 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 emp2 = jnp.where(done_goal, jnp.int32(0), emp2)
                 return agg2, tables2, gi2, rig2, emp2, metrics_b, left - rounds
 
+            def skip_branch(op):
+                # disabled goal (runtime subset mask): advance the cursor in
+                # one step — zero rounds, no table contribution, metrics rows
+                # untouched — exactly what a program traced without this goal
+                # would compute
+                agg_b, tables_b, gi, rig, emp, metrics_b, left = op
+                return (
+                    agg_b, tables_b, gi + 1, jnp.int32(0), jnp.int32(0),
+                    metrics_b, left,
+                )
+
             def named_branch(op):
                 # named_scope at trace time: this goal's switch branch carries
                 # its name in xplane op metadata (parse_xplane.py correlation)
                 with jax.named_scope(f"cc-goal-{goal.name}"):
-                    return branch(op)
+                    gi = op[2]
+                    gim = jnp.where(gi >= n_goals, gi - n_goals, gi)
+                    return jax.lax.cond(enabled[gim], branch, skip_branch, op)
 
             return named_branch
 
@@ -1007,7 +1077,10 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
         )
         return agg2, tables2, gi2, rig2, emp2, metrics2, budget - left2
 
-    return jax.jit(machine)
+    # donate the buffers the chunked driver threads through repeated calls
+    # (agg / tables / metrics): XLA reuses their device memory for the
+    # outputs instead of copying the big arrays every chunk
+    return jax.jit(machine, donate_argnums=(1, 2, 6))
 
 
 def empty_stack_metrics(n_goals: int) -> StackMetrics:
@@ -1104,6 +1177,14 @@ _COMPILED_STACKS_MAX = _PROGRAM_CACHE_SIZE
 _BUILD_LOCK = threading.Lock()
 
 
+def bucket_label(dims: Dims) -> str:
+    """Shape-bucket identity as a sensor/span label (padded axis sizes)."""
+    return (
+        f"P{dims.num_partitions}-B{dims.num_brokers}"
+        f"-T{dims.num_topics}-RF{dims.max_rf}"
+    )
+
+
 def _compile_cached(key, tag, dims, build):
     import logging
 
@@ -1120,7 +1201,10 @@ def _compile_cached(key, tag, dims, build):
                 "compiling %s: P=%d B=%d T=%d",
                 tag, dims.num_partitions, dims.num_brokers, dims.num_topics,
             )
-            with TRACER.span("optimizer.compile", kind="compile", program=tag):
+            with TRACER.span(
+                "optimizer.compile", kind="compile", program=tag,
+                bucket=bucket_label(dims),
+            ):
                 lowered = build()
                 t1 = time.monotonic()
                 ex = lowered.compile()
@@ -1128,11 +1212,20 @@ def _compile_cached(key, tag, dims, build):
                 "%s compiled in %.1fs (trace/lower %.1fs, XLA %.1fs)",
                 tag, time.monotonic() - t0, t1 - t0, time.monotonic() - t1,
             )
-            REGISTRY.histogram("GoalOptimizer.stack-compile-timer").record(
-                time.monotonic() - t0
-            )
+            compile_s = time.monotonic() - t0
+            REGISTRY.histogram("GoalOptimizer.stack-compile-timer").record(compile_s)
+            # per-bucket twin of the compile histogram: the padded shape IS
+            # the program identity, so a compile storm attributes to the
+            # bucket that caused it (docs/OBSERVABILITY.md)
+            REGISTRY.histogram(
+                "GoalOptimizer.stack-compile-timer.bucket." + bucket_label(dims)
+            ).record(compile_s)
             _COMPILED_STACKS[key] = ex
             while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
+                # bounded cache: bucket churn (many distinct cluster shapes
+                # through one process) must not grow compiled-program memory
+                # without limit — each XLA:CPU program pins ~1k memory maps
+                REGISTRY.meter("GoalOptimizer.program-cache-evictions").mark()
                 _COMPILED_STACKS.popitem(last=False)
         else:
             REGISTRY.meter("GoalOptimizer.program-cache-hits").mark()
@@ -1177,9 +1270,29 @@ def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
         key, tag, dims,
         lambda: _cached_goal_machine(goal_names, dims, settings).lower(
             static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-            empty_stack_metrics(len(goal_names)), jnp.int32(1)
+            empty_stack_metrics(len(goal_names)), jnp.int32(1),
+            jnp.ones((len(goal_names),), dtype=bool),
         ),
     )
+
+
+def _machine_goal_plan(requested: Tuple[str, ...]):
+    """(machine_names, enabled, rows): which goal list the chunked machine
+    program is traced for, and how the requested goals map onto it.
+
+    Any request that is a subset of the default stack runs through the
+    FULL-stack machine with the runtime `enabled` mask — one compiled
+    program per shape bucket serves every such request (a 2-goal rebalance,
+    the 4-goal usage sweep, the full stack), instead of one program per goal
+    subset. Non-default goal lists (kafka-assigner mode) keep their own
+    exact program."""
+    from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER
+
+    default_names = tuple(g.name for g in DEFAULT_GOAL_ORDER)
+    machine_names = default_names if set(requested) <= set(default_names) else requested
+    enabled = np.array([n in requested for n in machine_names])
+    rows = np.array([machine_names.index(n) for n in requested], dtype=np.int64)
+    return machine_names, enabled, rows
 
 
 # -- results -------------------------------------------------------------------
@@ -1215,6 +1328,10 @@ class OptimizerResult:
     num_leadership_moves: int
     data_to_move_mb: float
     duration_s: float
+    #: shape-bucketing record: exact model dims vs the padded dims the
+    #: compiled program is shaped for (None when the optimizer returned
+    #: before preparing a context)
+    bucketed: Optional[Dict] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -1272,25 +1389,40 @@ class GoalOptimizer:
         self._constraint = constraint or BalancingConstraint.default()
         self._settings = settings
         self._mesh = mesh
+        #: (model identity, options identity) -> prepared context. Keeps the
+        #: padded model + StaticCtx RESIDENT ON DEVICE across proposal
+        #: computations on the same model (warmup -> timed run, the facade's
+        #: cached-model recomputes): the second call skips padding, mask
+        #: construction, and the host->device transfer of every static array
+        #: — only the cheap aggregates kernel re-runs (its output is donated
+        #: into the machine and cannot be reused). Entries hold strong refs
+        #: to the keyed arrays, so the id-based key cannot alias.
+        self._prep_cache: "collections.OrderedDict" = collections.OrderedDict()
 
-    def _run_chunked(self, goal_names: Tuple[str, ...], dims: Dims, static, agg):
+    def _run_chunked(self, goal_names: Tuple[str, ...], enabled, dims: Dims,
+                     static, agg):
         """Drive the goal machine: repeated bounded device calls, each
         advancing the stack by up to `chunk` rounds (crossing goal boundaries
         inside the call — see _make_goal_machine).
 
-        Exactly one host sync per call (the cursor/rounds read); the per-call
-        budget adapts to the measured round rate so small problems coalesce
-        into a couple of large calls while north-star problems stay under the
-        remote-TPU transport deadline."""
+        `goal_names` is the MACHINE goal list (usually the full default
+        stack) and `enabled` the runtime subset mask (_machine_goal_plan);
+        returned metrics/durations are [len(goal_names)]-rowed — the caller
+        selects the requested rows. Exactly one host sync per call (the
+        cursor/rounds read); the per-call budget adapts to the measured round
+        rate so small problems coalesce into a couple of large calls while
+        north-star problems stay under the remote-TPU transport deadline."""
         from cruise_control_tpu.analyzer.acceptance import empty_tables as _empty
 
         tables = _empty(dims)
         metrics = empty_stack_metrics(len(goal_names))
+        enabled_dev = jnp.asarray(enabled, dtype=bool)
         if self._mesh is not None:
             from cruise_control_tpu.parallel.sharding import place_replicated
 
             tables = place_replicated(tables, self._mesh)
             metrics = place_replicated(metrics, self._mesh)
+            enabled_dev = place_replicated(enabled_dev, self._mesh)
         machine = _machine_executable(
             goal_names, dims, self._settings, self._mesh, static, agg, tables
         )
@@ -1323,7 +1455,7 @@ class GoalOptimizer:
             ) as call_span, jax.profiler.TraceAnnotation("cc-machine-call"):
                 agg, tables, gi, rig, emp, metrics, spent = machine(
                     static, agg, tables, gi, rig, emp, metrics,
-                    jnp.int32(max(1, chunk)),
+                    jnp.int32(max(1, chunk)), enabled_dev,
                 )
                 gi_h, spent_h, rounds_h = jax.device_get((gi, spent, metrics.rounds))
                 call_span.attributes["rounds"] = int(spent_h)
@@ -1373,9 +1505,57 @@ class GoalOptimizer:
     ):
         """Shared front half of optimizations()/warmup(): pad + bucket +
         (mesh-)place the model, build the static context and initial
-        aggregates. Returns (goals, p_orig, model, dims, static, agg)."""
+        aggregates. Returns (goals, p_orig, model, dims, static, agg).
+
+        The padded model/StaticCtx are cached per (model, options) identity
+        (see _prep_cache) so repeat computations on the same cluster keep
+        the static arrays resident on device; the aggregates are recomputed
+        each call because the optimizer DONATES them."""
         goals = goals_by_priority(goal_names)
+        key = self._prepare_key(model, options)
+        hit = self._prep_cache.get(key)
+        if hit is not None:
+            self._prep_cache.move_to_end(key)
+            REGISTRY.meter("GoalOptimizer.static-ctx-cache-hits").mark()
+            p_orig, pmodel, dims, static, bucketed = hit[:5]
+        else:
+            REGISTRY.meter("GoalOptimizer.static-ctx-cache-misses").mark()
+            p_orig, pmodel, dims, static, bucketed = self._build_ctx(model, options)
+            # the entry references `model`/`options` to pin the key's ids
+            self._prep_cache[key] = (
+                p_orig, pmodel, dims, static, bucketed, model, options,
+            )
+            while len(self._prep_cache) > 2:
+                self._prep_cache.popitem(last=False)
+        agg = _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
+        if self._mesh is not None:
+            from cruise_control_tpu.parallel.sharding import place_aggregates
+
+            agg = place_aggregates(agg, self._mesh)
+        return goals, p_orig, pmodel, dims, static, agg, bucketed
+
+    @staticmethod
+    def _prepare_key(model: FlatClusterModel, options: OptimizationOptions):
+        """Identity key over the model's arrays and the options' contents.
+
+        Array fields key by object identity (cheap; the cache entry holds
+        the referenced objects, so a live key id can never alias a new
+        array); scalar/tuple option fields key by value."""
+
+        def kid(v):
+            return ("id", id(v)) if v is not None and not isinstance(
+                v, (bool, int, float, str, tuple)
+            ) else v
+
+        return tuple(id(f) for f in model) + tuple(
+            kid(getattr(options, f.name)) for f in dataclasses.fields(options)
+        )
+
+    def _build_ctx(self, model: FlatClusterModel, options: OptimizationOptions):
+        """Bucket every model axis up its ladder, pad the model, and build
+        the device-resident StaticCtx (the _prep_cache miss path)."""
         p_orig = model.num_partitions
+        b_orig = model.num_brokers
         if (
             options.destination_broker_ids is not None
             or options.excluded_topic_pattern is not None
@@ -1387,13 +1567,17 @@ class GoalOptimizer:
 
             options = resolve_options(options, model)
         from cruise_control_tpu.parallel.sharding import (
+            geom_bucket,
+            pad_brokers_to,
             pad_partitions_to,
             partition_bucket,
         )
 
+        s = self._settings
+        exact = dims_of(model)
         # pad the partition axis: coarse buckets absorb topic churn (no
         # recompiles for +-1 partition), and a mesh needs a multiple of its size
-        target_p = partition_bucket(p_orig) if self._settings.bucket_partitions else p_orig
+        target_p = partition_bucket(p_orig) if s.bucket_partitions else p_orig
         if self._mesh is not None:
             m = self._mesh.size
             target_p = target_p + ((-target_p) % m)
@@ -1407,27 +1591,76 @@ class GoalOptimizer:
                         [np.asarray(options.excluded_partitions, dtype=bool), pad]
                     ),
                 )
+        # bucket the topic axis too: topic add/remove changes num_topics,
+        # which would otherwise recompile the stack (hi_topic[T] and
+        # topic_replica_count[T, B] shapes); padded topic rows hold zero
+        # replicas and bounds [0, 0], so they are inert.
+        num_topics = (
+            partition_bucket(exact.num_topics) if s.bucket_partitions else exact.num_topics
+        )
+        # bucket the broker/host/rack axes up the geometric ladder: one
+        # compiled program serves every cluster that rounds into the bucket,
+        # so broker churn (add/remove, +-5% drift) reuses the warm program.
+        # Padding brokers are INVALID (zero capacity, neither alive nor
+        # dead) — see pad_brokers_to and the StaticCtx.broker_valid mask.
+        num_racks, num_hosts, target_b = exact.num_racks, exact.num_hosts, b_orig
+        if s.bucket_brokers:
+            target_b = geom_bucket(b_orig, s.bucket_ratio, s.bucket_floor)
+            num_racks = geom_bucket(exact.num_racks, s.bucket_ratio, s.bucket_floor)
+            num_hosts = geom_bucket(exact.num_hosts, s.bucket_ratio, s.bucket_floor)
+            if target_b != b_orig:
+                model = pad_brokers_to(model, target_b, num_racks, num_hosts)
+
+                def pad_mask(arr):
+                    if arr is None:
+                        return None
+                    return np.concatenate(
+                        [
+                            np.asarray(arr, dtype=bool),
+                            np.zeros(target_b - b_orig, dtype=bool),
+                        ]
+                    )
+
+                options = dataclasses.replace(
+                    options,
+                    excluded_brokers_for_leadership=pad_mask(
+                        options.excluded_brokers_for_leadership
+                    ),
+                    excluded_brokers_for_replica_move=pad_mask(
+                        options.excluded_brokers_for_replica_move
+                    ),
+                    requested_destination_brokers=pad_mask(
+                        options.requested_destination_brokers
+                    ),
+                )
+        dims = Dims(
+            num_partitions=model.num_partitions,
+            max_rf=exact.max_rf,
+            num_brokers=target_b,
+            num_racks=num_racks,
+            num_hosts=num_hosts,
+            num_topics=num_topics,
+        )
         if self._mesh is not None:
-            from cruise_control_tpu.parallel.sharding import (
-                place_aggregates,
-                place_static,
-                shard_model,
-            )
+            from cruise_control_tpu.parallel.sharding import place_static, shard_model
 
             model = shard_model(model, self._mesh)
-        dims = dims_of(model)
-        if self._settings.bucket_partitions:
-            # bucket the topic axis too: topic add/remove changes num_topics,
-            # which would otherwise recompile the stack (hi_topic[T] and
-            # topic_replica_count[T, B] shapes); padded topic rows hold zero
-            # replicas and bounds [0, 0], so they are inert.
-            dims = dataclasses.replace(dims, num_topics=partition_bucket(dims.num_topics))
-        static = build_static_ctx(model, self._constraint, dims, options)
-        agg = _jit_compute_aggregates(static, jnp.asarray(model.assignment), dims)
+        static = build_static_ctx(
+            model, self._constraint, dims, options,
+            valid_brokers=b_orig, valid_partitions=p_orig,
+        )
         if self._mesh is not None:
             static = place_static(static, self._mesh)
-            agg = place_aggregates(agg, self._mesh)
-        return goals, p_orig, model, dims, static, agg
+        # exact vs padded shape record (the bench's `bucketed` detail block):
+        # what the cluster measured vs what the compiled program is shaped for
+        bucketed = {
+            "exact": dataclasses.asdict(exact),
+            "padded": dataclasses.asdict(dims),
+            "bucket": bucket_label(dims),
+            "paddedPartitions": dims.num_partitions - p_orig,
+            "paddedBrokers": dims.num_brokers - b_orig,
+        }
+        return p_orig, model, dims, static, bucketed
 
     def warmup(
         self,
@@ -1448,7 +1681,9 @@ class GoalOptimizer:
             return self._warmup(model, goal_names, options, t0)
 
     def _warmup(self, model, goal_names, options, t0) -> float:
-        goals, _, model, dims, static, agg = self._prepare(model, goal_names, options)
+        goals, _, model, dims, static, agg, _bucketed = self._prepare(
+            model, goal_names, options
+        )
         goal_names_t = tuple(g.name for g in goals)
         # the stats program runs in every optimizations() call too — without
         # this, its first-use compile would contaminate the first timed run
@@ -1456,24 +1691,29 @@ class GoalOptimizer:
         if self._settings.chunk_rounds > 0:
             from cruise_control_tpu.analyzer.acceptance import empty_tables as _empty
 
+            machine_names, enabled, _rows = _machine_goal_plan(goal_names_t)
             tables = _empty(dims)
+            enabled_dev = jnp.asarray(enabled, dtype=bool)
             if self._mesh is not None:
                 from cruise_control_tpu.parallel.sharding import place_replicated
 
                 tables = place_replicated(tables, self._mesh)
+                enabled_dev = place_replicated(enabled_dev, self._mesh)
             machine = _machine_executable(
-                goal_names_t, dims, self._settings, self._mesh, static, agg, tables
+                machine_names, dims, self._settings, self._mesh, static, agg, tables
             )
             out = machine(
                 static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-                empty_stack_metrics(len(goal_names_t)), jnp.int32(1),
+                empty_stack_metrics(len(machine_names)), jnp.int32(1),
+                enabled_dev,
             )
             jax.block_until_ready(out[6])
             if self._settings.polish_rounds > 0:
                 # the final-state re-measure runs in every polished
                 # optimizations() call; compile it here, not in the timed run
+                # (out[0] — `agg` itself was donated to the machine call)
                 jax.block_until_ready(
-                    _cached_measure(goal_names_t, dims)(static, agg)
+                    _cached_measure(machine_names, dims)(static, out[0])
                 )
         else:
             step = _stack_executable(
@@ -1532,7 +1772,7 @@ class GoalOptimizer:
         progress,
     ) -> OptimizerResult:
         t0 = time.monotonic()
-        goals, p_orig, model, dims, static, agg = self._prepare(
+        goals, p_orig, model, dims, static, agg, bucketed = self._prepare(
             model, goal_names, options
         )
         if not goals:
@@ -1545,6 +1785,7 @@ class GoalOptimizer:
                 final_assignment=np.asarray(model.assignment)[:p_orig],
                 num_replica_moves=0, num_leadership_moves=0,
                 data_to_move_mb=0.0, duration_s=time.monotonic() - t0,
+                bucketed=bucketed,
             )
         init_assignment = jnp.asarray(model.assignment)
 
@@ -1553,9 +1794,14 @@ class GoalOptimizer:
         goal_names_t = tuple(g.name for g in goals)
         goal_durs: Optional[np.ndarray] = None
         if self._settings.chunk_rounds > 0:
+            machine_names, enabled, rows = _machine_goal_plan(goal_names_t)
             agg, metrics, stack_s, goal_durs = self._run_chunked(
-                goal_names_t, dims, static, agg
+                machine_names, enabled, dims, static, agg
             )
+            # machine metrics are rowed by the (full) machine goal list;
+            # select the requested goals' rows back out
+            metrics = StackMetrics(*(np.asarray(a)[rows] for a in metrics))
+            goal_durs = goal_durs[rows]
         else:
             step = _stack_executable(
                 goal_names_t, dims, self._settings, self._mesh, static, agg
@@ -1659,4 +1905,5 @@ class GoalOptimizer:
             num_leadership_moves=n_leader,
             data_to_move_mb=float(data_mb),
             duration_s=wall,
+            bucketed=bucketed,
         )

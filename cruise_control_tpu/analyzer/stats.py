@@ -85,13 +85,22 @@ def compute_stats(model: FlatClusterModel, num_topics: int) -> ClusterModelStats
     r_mean, r_std, r_min, r_max = _masked_stats(replicas, alive)
     l_mean, l_std, _, _ = _masked_stats(leaders, alive)
 
-    # per-topic replica spread across alive brokers
+    # per-topic replica spread across alive brokers. The mean runs over
+    # topics that actually hold replicas: `num_topics` may be a shape bucket
+    # (analyzer.optimizer shape bucketing), and empty padded topic rows in
+    # the denominator would make the statistic drift with the bucket size
+    # instead of matching the exact-shape model. Real topics always hold at
+    # least one replica (every partition has a leader), so the mask is
+    # exactly the padding mask.
     t_counts = topic_replica_counts(model, num_topics).astype(jnp.float32)  # [T, B]
     alive_f = alive.astype(jnp.float32)[None, :]
     n_alive = jnp.maximum(jnp.sum(alive_f, axis=1), 1.0)
     t_mean = jnp.sum(t_counts * alive_f, axis=1, keepdims=True) / n_alive[:, None]
     t_var = jnp.sum(jnp.where(alive_f > 0, (t_counts - t_mean) ** 2, 0.0), axis=1) / n_alive
-    topic_std = jnp.mean(jnp.sqrt(t_var))
+    t_nonempty = jnp.sum(t_counts, axis=1) > 0.0
+    topic_std = jnp.sum(jnp.where(t_nonempty, jnp.sqrt(t_var), 0.0)) / jnp.maximum(
+        jnp.sum(t_nonempty.astype(jnp.float32)), 1.0
+    )
 
     pnw = potential_nw_out(model)
     p_mean, _, _, p_max = _masked_stats(pnw, alive)

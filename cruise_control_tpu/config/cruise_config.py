@@ -131,6 +131,20 @@ def _config_def() -> ConfigDef:
              "After the priority stack completes, re-run every goal up to this many rounds "
              "under the FULL merged acceptance tables (retries goals an earlier lexicographic "
              "pass stalled). 0 disables the polish pass.")
+    d.define("optimizer.bucket.partitions", Type.BOOLEAN, True, None, Importance.MEDIUM,
+             "Pad the partition/topic axes to coarse shape buckets so partition-count and "
+             "topic-count churn reuses compiled programs instead of recompiling the stack.")
+    d.define("optimizer.bucket.brokers", Type.BOOLEAN, True, None, Importance.MEDIUM,
+             "Pad the broker/host/rack axes up the geometric bucket ladder so broker churn "
+             "(add/remove, count drift) reuses the warm compiled program of the shared "
+             "bucket. Padding brokers are invalid: never destinations, never in any goal "
+             "window — bucketed runs are result-identical to the exact shape.")
+    d.define("optimizer.bucket.ratio", Type.DOUBLE, 1.25, between(1.01, 2.0), Importance.LOW,
+             "Geometric step of the broker bucket ladder (1.25 = quarter-octave rungs, "
+             "worst-case 25% padding).")
+    d.define("optimizer.bucket.floor", Type.INT, 64, at_least(1), Importance.LOW,
+             "Broker counts at or below this stay exact (no padding); tiny clusters "
+             "recompile per shape but pay zero padding overhead.")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

@@ -97,16 +97,82 @@ def size_bucket(n: int) -> int:
     that several test modules share (test_executor / test_facade_detector /
     test_rest) key to ONE compiled stack program instead of three.
     """
-    if n <= 32:
-        return n
-    if n <= 64:
-        return 64
-    step = max(8, 1 << (n.bit_length() - 4))
-    return ((n + step - 1) // step) * step
+    return geom_bucket(n, ratio=1.125, floor=32)
 
 
 #: historical name for the partition-axis use
 partition_bucket = size_bucket
+
+
+def geom_bucket(n: int, ratio: float = 1.25, floor: int = 64) -> int:
+    """Round an axis size up a geometric bucket ladder (~`ratio` steps).
+
+    The generalized form of `size_bucket` for every model axis (brokers,
+    hosts, racks, topics, partitions): rungs are multiples of a power-of-two
+    step, with granularity derived from `ratio` (1.25 -> quarter-octave
+    rungs, worst-case padding 25%; 1.125 -> eighth-octave, 12.5%). Any axis
+    size inside a rung reuses the rung's compiled programs, so churn — a
+    broker add/remove, partition-count drift — stays inside a warm program
+    instead of recompiling the stack. Sizes <= `floor` stay EXACT: tiny
+    fixtures pay no padding, and the sub-`floor` regime is where padded and
+    exact candidate-grid clamps could diverge (docs/OPTIMIZER.md); the
+    32..64 range buckets to 64 (one shared rung for the seeded ~60-row test
+    models) whenever the floor admits it.
+    """
+    if n <= floor:
+        return n
+    if n <= 64:
+        return 64
+    g = max(2, round(1.0 / (ratio - 1.0)))  # rungs per octave
+    step = max(1, (1 << (n.bit_length() - 1)) // g)
+    return ((n + step - 1) // step) * step
+
+
+def pad_brokers_to(
+    model: FlatClusterModel, target_b: int, num_racks: int, num_hosts: int
+) -> FlatClusterModel:
+    """Pad the broker axis up to exactly `target_b` rows.
+
+    Padding brokers are INVALID, not merely dead: zero capacity, DEAD state
+    at the model level (so model-level alive-masked stats skip them), and —
+    through `build_static_ctx(valid_brokers=...)` — excluded from BOTH the
+    `alive` and `dead` masks, so they are never move destinations, never
+    evacuation sources, and never enter any goal's averages or windows.
+    They live on the padded rack/host ids (when `num_racks`/`num_hosts`
+    exceed the real counts) so real racks' and hosts' aggregates stay
+    byte-identical to the unpadded model; with no padded rack/host rows
+    they round-robin over the real ones, which zero-capacity zero-load rows
+    cannot perturb.
+    """
+    b = model.num_brokers
+    pad = target_b - b
+    if pad <= 0:
+        return model
+    cap = np.asarray(model.broker_capacity)
+    rack = np.asarray(model.broker_rack)
+    host = np.asarray(model.broker_host)
+    state = np.asarray(model.broker_state)
+    nr = int(rack.max()) + 1 if rack.size else 0
+    nh = int(host.max()) + 1 if host.size else 0
+    idx = np.arange(pad)
+    pad_rack = (
+        nr + idx % (num_racks - nr) if num_racks > nr else idx % max(nr, 1)
+    ).astype(rack.dtype)
+    pad_host = (
+        nh + idx % (num_hosts - nh) if num_hosts > nh else idx % max(nh, 1)
+    ).astype(host.dtype)
+    from cruise_control_tpu.common.resources import BrokerState
+
+    return model._replace(
+        broker_capacity=np.concatenate(
+            [cap, np.zeros((pad, cap.shape[1]), dtype=cap.dtype)], axis=0
+        ),
+        broker_rack=np.concatenate([rack, pad_rack]),
+        broker_host=np.concatenate([host, pad_host]),
+        broker_state=np.concatenate(
+            [state, np.full(pad, BrokerState.DEAD, dtype=state.dtype)]
+        ),
+    )
 
 
 def shard_model(model: FlatClusterModel, mesh: Mesh) -> FlatClusterModel:

@@ -1,0 +1,262 @@
+"""Shape-bucketed program reuse: the padding-equivalence and compile-reuse
+contracts of the optimizer's bucket ladder (analyzer.optimizer._build_ctx,
+parallel.sharding.geom_bucket/pad_brokers_to).
+
+Two properties are load-bearing:
+
+  1. EQUIVALENCE — a bucketed run (padded partition/broker/host axes) must
+     produce byte-identical moves, violated sets, costs, and round counts vs
+     the exact-shape run on the same model: bucketing buys compile reuse,
+     never changes proposals.
+  2. REUSE — two cluster sizes that round into the same bucket must share
+     ONE compiled program: the second run pays zero compiles and records a
+     program-cache hit.
+
+Module layout is compile-aware (the suite is compile-bound): the equivalence
+pair and the reuse guard share one goal subset and one padded shape, so the
+whole module compiles exactly two stack programs (exact + padded).
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.context import build_static_ctx, dims_of
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+from cruise_control_tpu.parallel.sharding import geom_bucket, pad_brokers_to
+
+#: three goal families (the padding-equivalence contract's minimum):
+#: rack-aware (hard/grid), count-distribution (bulk planner at B >= 32),
+#: resource-distribution (drain + swap search) — plus the leadership count
+#: goal (rotated drain candidates + promotion family)
+GOALS = [
+    "RackAwareGoal",
+    "ReplicaDistributionGoal",
+    "DiskUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+]
+#: > bucket_floor so the broker axis genuinely pads (70 -> 80); one dead
+#: broker keeps the evacuation path in the compared programs
+PROP = ClusterProperty(
+    num_racks=7, num_brokers=70, num_topics=20,
+    mean_partitions_per_topic=10.0, replication_factor=2, num_dead_brokers=1,
+)
+BASE = dict(
+    batch_k=16, max_rounds_per_goal=24, num_dst_candidates=8,
+    drain_src=128, apply_waves=4,
+)
+
+
+def _meter(name):
+    return REGISTRY.meter(f"GoalOptimizer.{name}").snapshot()["count"]
+
+
+@pytest.fixture(scope="module")
+def model():
+    return random_cluster(7, PROP)
+
+
+@pytest.fixture(scope="module")
+def exact_result(model):
+    opt = GoalOptimizer(settings=OptimizerSettings(
+        bucket_partitions=False, bucket_brokers=False, **BASE))
+    return opt.optimizations(model, GOALS, raise_on_hard_failure=False)
+
+
+@pytest.fixture(scope="module")
+def padded_result(model):
+    opt = GoalOptimizer(settings=OptimizerSettings(
+        bucket_partitions=True, bucket_brokers=True, **BASE))
+    return opt.optimizations(model, GOALS, raise_on_hard_failure=False)
+
+
+class TestBucketLadder:
+    def test_floor_is_exact(self):
+        for n in (1, 3, 20, 32, 64):
+            assert geom_bucket(n) == n
+
+    def test_monotone_and_idempotent(self):
+        prev = 0
+        for n in range(1, 4000, 7):
+            b = geom_bucket(n)
+            assert b >= n
+            assert b >= prev  # ladder is monotone
+            assert geom_bucket(b) == b  # a rung maps to itself
+            prev = b
+
+    def test_overhead_bounded_by_ratio(self):
+        for n in (65, 100, 500, 2600, 100_000):
+            assert geom_bucket(n, ratio=1.25) <= n * 1.25
+            assert geom_bucket(n, ratio=1.125, floor=32) <= n * 1.125 + 8
+
+    def test_neighbors_share_a_rung(self):
+        # +-5% broker drift around a typical size stays inside one bucket
+        assert geom_bucket(68) == geom_bucket(72) == 80
+        assert geom_bucket(2570) == geom_bucket(2600) == 3072
+
+
+class TestPaddingMasks:
+    def test_padded_brokers_neither_alive_nor_dead(self, model):
+        b = model.num_brokers
+        padded = pad_brokers_to(model, 80, num_racks=8, num_hosts=80)
+        assert padded.num_brokers == 80
+        # model level: DEAD state keeps padding out of alive-masked stats
+        assert (np.asarray(padded.broker_state)[b:] == BrokerState.DEAD).all()
+        assert (np.asarray(padded.broker_capacity)[b:] == 0.0).all()
+        # padding lives on the padded rack/host ids, not real ones
+        assert (np.asarray(padded.broker_rack)[b:] >= 7).all()
+        assert (np.asarray(padded.broker_host)[b:] >= b).all()
+        dims = dims_of(padded)
+        static = build_static_ctx(
+            padded, BalancingConstraint.default(), dims, valid_brokers=b
+        )
+        alive = np.asarray(static.alive)
+        dead = np.asarray(static.dead)
+        valid = np.asarray(static.broker_valid)
+        assert not alive[b:].any() and not dead[b:].any() and not valid[b:].any()
+        # the REAL dead broker stays dead; real alive brokers stay alive
+        state = np.asarray(model.broker_state)
+        assert (dead[:b] == (state == BrokerState.DEAD)).all()
+        assert (alive[:b] == (state != BrokerState.DEAD)).all()
+        # padding is never an eligible destination
+        assert not np.asarray(static.replica_dst_ok)[b:].any()
+        assert not np.asarray(static.leadership_dst_ok)[b:].any()
+
+    def test_stats_are_padding_invariant(self, model):
+        import jax
+
+        from cruise_control_tpu.analyzer.stats import compute_stats, stats_to_dict
+        from cruise_control_tpu.parallel.sharding import pad_partitions_to
+
+        padded = pad_brokers_to(model, 80, num_racks=8, num_hosts=80)
+        padded = pad_partitions_to(padded, model.num_partitions + 9)
+        s_exact = stats_to_dict(jax.device_get(
+            compute_stats(model, model.num_topics)))
+        s_pad = stats_to_dict(jax.device_get(
+            compute_stats(padded, model.num_topics + 5)))
+
+        def close(a, b, path=""):
+            if isinstance(a, dict):
+                assert a.keys() == b.keys(), path
+                for k in a:
+                    close(a[k], b[k], f"{path}.{k}")
+            elif isinstance(a, float):
+                # cross-broker/topic reductions differ by f32 ulps when the
+                # padded axis length changes the reduction tree
+                np.testing.assert_allclose(a, b, rtol=2e-6, err_msg=path)
+            else:
+                assert a == b, path
+
+        close(s_exact, s_pad)
+
+
+class TestPaddingEquivalence:
+    """Bucketing buys compile reuse, never changes proposals: the padded run
+    is byte-identical to the exact-shape run on the same model."""
+
+    def test_shapes_actually_padded(self, model, padded_result, exact_result):
+        assert exact_result.bucketed["paddedBrokers"] == 0
+        assert padded_result.bucketed["paddedBrokers"] == 10
+        assert padded_result.bucketed["padded"]["num_brokers"] == 80
+        assert padded_result.bucketed["exact"]["num_brokers"] == 70
+
+    def test_assignment_identical(self, exact_result, padded_result):
+        assert np.array_equal(
+            exact_result.final_assignment, padded_result.final_assignment
+        )
+
+    def test_proposals_identical(self, exact_result, padded_result):
+        assert exact_result.num_replica_moves == padded_result.num_replica_moves
+        assert exact_result.num_leadership_moves == padded_result.num_leadership_moves
+        e = [(p.partition, tuple(p.new_replicas)) for p in exact_result.proposals]
+        p = [(p.partition, tuple(p.new_replicas)) for p in padded_result.proposals]
+        assert e == p
+
+    def test_per_goal_costs_violations_rounds_identical(
+        self, exact_result, padded_result
+    ):
+        for ge, gp in zip(exact_result.goal_results, padded_result.goal_results):
+            assert ge.name == gp.name
+            assert ge.violated_brokers_before == gp.violated_brokers_before
+            assert ge.violated_brokers_after == gp.violated_brokers_after
+            # DECISIONS are byte-identical (per-broker aggregates and scores
+            # are element-wise, unaffected by axis padding); the scalar cost
+            # REPORT is a cross-broker reduction whose association tree
+            # varies with the padded axis length — equal to f32 ulps
+            np.testing.assert_allclose(ge.cost_before, gp.cost_before, rtol=2e-6)
+            np.testing.assert_allclose(ge.cost_after, gp.cost_after, rtol=2e-6)
+            assert ge.rounds == gp.rounds
+            assert ge.converged == gp.converged
+
+    def test_no_proposal_references_padding(self, model, padded_result):
+        b = model.num_brokers
+        final = padded_result.final_assignment
+        assert final.shape[0] == model.num_partitions
+        assert final[final >= 0].max() < b
+
+
+class TestCompileReuseGuard:
+    """Two cluster sizes in one bucket share one compiled machine program:
+    the second run shows zero recompiles and a program-cache hit."""
+
+    def test_same_bucket_reuses_program(self, model, padded_result):
+        # same seed => identical partition draw; only the broker count moves
+        m68 = random_cluster(7, ClusterProperty(
+            num_racks=7, num_brokers=68, num_topics=20,
+            mean_partitions_per_topic=10.0, replication_factor=2))
+        m72 = random_cluster(7, ClusterProperty(
+            num_racks=7, num_brokers=72, num_topics=20,
+            mean_partitions_per_topic=10.0, replication_factor=2))
+        opt = GoalOptimizer(settings=OptimizerSettings(
+            bucket_partitions=True, bucket_brokers=True, **BASE))
+        m0 = _meter("program-cache-misses")
+        r1 = opt.optimizations(m68, GOALS, raise_on_hard_failure=False)
+        m1 = _meter("program-cache-misses")
+        # 68 brokers pads into the SAME bucket the padded_result fixture
+        # compiled (B80/P192) — at most one cold compile if this test runs
+        # standalone, zero when the module fixture already warmed it
+        assert r1.bucketed["bucket"] == padded_result.bucketed["bucket"]
+        assert m1 - m0 <= 1
+        h1 = _meter("program-cache-hits")
+        r2 = opt.optimizations(m72, GOALS, raise_on_hard_failure=False)
+        m2 = _meter("program-cache-misses")
+        h2 = _meter("program-cache-hits")
+        assert r2.bucketed["bucket"] == r1.bucketed["bucket"]
+        assert m2 - m1 == 0, "second size in the bucket must not recompile"
+        assert h2 - h1 >= 1, "second size must hit the warm program"
+
+    def test_static_ctx_cache_hits_on_same_model(self, model):
+        opt = GoalOptimizer(settings=OptimizerSettings(
+            bucket_partitions=True, bucket_brokers=True, **BASE))
+        h0 = _meter("static-ctx-cache-hits")
+        opt.optimizations(model, GOALS, raise_on_hard_failure=False)
+        opt.optimizations(model, GOALS, raise_on_hard_failure=False)
+        assert _meter("static-ctx-cache-hits") - h0 >= 1
+
+
+@pytest.mark.slow
+class TestPaddingEquivalenceWideStack:
+    """Slow-lane twin over the pair-drain / leadership-relay / usage-band
+    families (TopicReplica + LeaderBytesIn + NetworkInboundUsage)."""
+
+    GOALS2 = [
+        "NetworkInboundUsageDistributionGoal",
+        "TopicReplicaDistributionGoal",
+        "LeaderBytesInDistributionGoal",
+    ]
+
+    def test_equivalent(self, model):
+        exact = GoalOptimizer(settings=OptimizerSettings(
+            bucket_partitions=False, bucket_brokers=False, **BASE))
+        padded = GoalOptimizer(settings=OptimizerSettings(
+            bucket_partitions=True, bucket_brokers=True, **BASE))
+        re_ = exact.optimizations(model, self.GOALS2, raise_on_hard_failure=False)
+        rp = padded.optimizations(model, self.GOALS2, raise_on_hard_failure=False)
+        assert np.array_equal(re_.final_assignment, rp.final_assignment)
+        for ge, gp in zip(re_.goal_results, rp.goal_results):
+            assert (ge.cost_after, ge.violated_brokers_after, ge.rounds) == (
+                gp.cost_after, gp.violated_brokers_after, gp.rounds
+            )

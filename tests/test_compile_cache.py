@@ -1,0 +1,69 @@
+"""Persistent-compile-cache wiring (cruise_control_tpu.compile_cache).
+
+On the CPU backend `enable_persistent_cache()` is a deliberate no-op unless
+CRUISE_CONTROL_JAX_CACHE_FORCE=1 (XLA:CPU AOT serialization is unreliable in
+this build); the forced path is what TPU processes exercise, so it gets a
+regression test here: enable -> second call is a no-op returning the same
+dir; an unwritable dir returns None. No jit compiles run while the cache is
+force-enabled — the test restores JAX's cache config before returning.
+"""
+
+import os
+
+import jax
+import pytest
+
+from cruise_control_tpu import compile_cache
+
+
+@pytest.fixture
+def _force_cache(monkeypatch):
+    """Arm the forced-CPU path with clean module/JAX state, restore after."""
+    monkeypatch.setenv("CRUISE_CONTROL_JAX_CACHE_FORCE", "1")
+    monkeypatch.delenv("CRUISE_CONTROL_JAX_CACHE", raising=False)
+    monkeypatch.setattr(compile_cache, "_enabled", None)
+    before = jax.config.jax_compilation_cache_dir
+    before_time = jax.config.jax_persistent_cache_min_compile_time_secs
+    before_size = jax.config.jax_persistent_cache_min_entry_size_bytes
+    yield
+    jax.config.update("jax_compilation_cache_dir", before)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", before_time)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", before_size)
+
+
+def test_cpu_backend_is_noop_without_force(monkeypatch):
+    monkeypatch.delenv("CRUISE_CONTROL_JAX_CACHE_FORCE", raising=False)
+    monkeypatch.setattr(compile_cache, "_enabled", None)
+    assert jax.default_backend() == "cpu"
+    assert compile_cache.enable_persistent_cache() is None
+
+
+def test_force_enables_and_second_call_is_noop(_force_cache, tmp_path):
+    target = str(tmp_path / "jax_cache")
+    got = compile_cache.enable_persistent_cache(target)
+    assert got == os.path.abspath(target)
+    assert os.path.isdir(got)
+    assert jax.config.jax_compilation_cache_dir == got
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+    assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    # second call — even with a DIFFERENT path — is a no-op returning the
+    # dir already in force (the enable-once contract)
+    other = str(tmp_path / "other")
+    assert compile_cache.enable_persistent_cache(other) == got
+    assert not os.path.exists(other)
+
+
+def test_force_env_dir_is_honored(_force_cache, tmp_path, monkeypatch):
+    target = str(tmp_path / "env_cache")
+    monkeypatch.setenv("CRUISE_CONTROL_JAX_CACHE", target)
+    assert compile_cache.enable_persistent_cache() == os.path.abspath(target)
+
+
+def test_unwritable_dir_returns_none(_force_cache, tmp_path):
+    blocker = tmp_path / "file"
+    blocker.write_text("not a directory")
+    # makedirs under a regular file fails -> None, and the cache stays off
+    before = jax.config.jax_compilation_cache_dir
+    assert compile_cache.enable_persistent_cache(str(blocker / "sub")) is None
+    assert compile_cache._enabled is None
+    assert jax.config.jax_compilation_cache_dir == before

@@ -18,11 +18,19 @@ import threading
 from typing import Callable, Dict, Optional, Tuple
 
 
+class DropConnection(Exception):
+    """Raised by a dispatch to close the client connection WITHOUT replying —
+    the transport-level fault surface (testing/faults.py injects it to
+    exercise client reconnect paths; a production agent may use it to shed a
+    misbehaving peer)."""
+
+
 class JsonLinesServer:
     """Threaded JSON-lines TCP server around a `dispatch(dict) -> dict`.
 
     Dispatch exceptions are answered as {"ok": False, "error": repr(e)} —
-    a malformed request must not kill the connection thread silently.
+    a malformed request must not kill the connection thread silently —
+    except DropConnection, which severs the connection unanswered.
     `ssl_context` (server-side) wraps each accepted connection in TLS.
     """
 
@@ -45,6 +53,8 @@ class JsonLinesServer:
                         return
                     try:
                         resp = dispatch(json.loads(line))
+                    except DropConnection:
+                        return
                     except Exception as e:
                         resp = {"ok": False, "error": repr(e)}
                     self.wfile.write(json.dumps(resp).encode() + b"\n")

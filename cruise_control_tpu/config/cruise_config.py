@@ -348,6 +348,26 @@ def _config_def() -> ConfigDef:
              "Mesh axis name candidate/partition arrays are sharded over.")
     d.define("tpu.donate.model.buffers", Type.BOOLEAN, True, None, Importance.LOW,
              "Donate model buffers between optimizer rounds to avoid copies.")
+    # --- resilience (TPU-native keys; docs/RESILIENCE.md)
+    d.define("executor.task.deadline.s", Type.DOUBLE, 0.0, at_least(0.0), Importance.MEDIUM,
+             "Per-task wall-clock deadline during execution: a task IN_PROGRESS longer "
+             "than this is aborted (ABORTING -> ABORTED) and its broker slots released, "
+             "while the rest of the batch continues. 0 disables (the poll cap still "
+             "bounds the phase).")
+    d.define("executor.retry.attempts", Type.INT, 4, at_least(1), Importance.MEDIUM,
+             "Attempts per cluster-agent op (reconnect-on-failure between attempts). "
+             "All five protocol ops are retry-safe: finished/ongoing/ping are reads, "
+             "reassign/leader are executionId-idempotent.")
+    d.define("executor.retry.backoff.s", Type.DOUBLE, 0.05, at_least(0.0), Importance.LOW,
+             "Base backoff before the first retry; doubles per attempt.")
+    d.define("executor.retry.max.backoff.s", Type.DOUBLE, 2.0, at_least(0.0), Importance.LOW,
+             "Backoff ceiling for the exponential ladder.")
+    d.define("selfhealing.breaker.threshold", Type.INT, 3, at_least(1), Importance.MEDIUM,
+             "Consecutive failed self-healing fixes of one anomaly type before that "
+             "type's circuit breaker opens and fixes degrade to delayed CHECKs.")
+    d.define("selfhealing.breaker.cooldown.s", Type.DOUBLE, 300.0, at_least(0.0), Importance.MEDIUM,
+             "Seconds an open self-healing breaker waits before admitting one "
+             "half-open probe fix (success closes it, failure re-opens).")
     # --- observability (TPU-native keys; docs/OBSERVABILITY.md)
     d.define("observability.trace.ring.size", Type.INT, 4096, at_least(16), Importance.LOW,
              "Completed tracer spans retained in memory (the /trace window); "

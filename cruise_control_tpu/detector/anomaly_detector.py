@@ -56,9 +56,36 @@ class AnomalyDetector:
         self._queue: "queue.Queue[Anomaly]" = queue.Queue()
         self._counts: Dict[str, int] = {t.name: 0 for t in AnomalyType}
         self._fixes: Dict[str, int] = {t.name: 0 for t in AnomalyType}
+        self._fix_failures: Dict[str, int] = {t.name: 0 for t in AnomalyType}
         self._recent: List[Dict] = []
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
+        self._register_breaker_gauge()
+
+    def _register_breaker_gauge(self) -> None:
+        """Expose breaker states on /metrics (0=closed, 1=half-open, 2=open);
+        full snapshots ride /state. Guarded: only notifiers with breakers
+        (SelfHealingNotifier and subclasses) report."""
+        from cruise_control_tpu.common.retry import CircuitBreaker
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        import weakref
+
+        ref = weakref.ref(self)
+
+        def breaker_codes():
+            det = ref()
+            if det is None:
+                return {}
+            breakers = getattr(det._notifier, "breakers_state", None)
+            if breakers is None:
+                return {}
+            return {
+                name: CircuitBreaker.STATE_CODES.get(snap["state"], -1)
+                for name, snap in breakers().items()
+            }
+
+        REGISTRY.gauge("AnomalyDetector.breaker-state", breaker_codes)
 
     # -- one detection round (callable directly; the loop just schedules it) ---
 
@@ -110,14 +137,26 @@ class AnomalyDetector:
             span.attributes["decision"] = result.name
             op_log("Anomaly %s: notifier decided %s", anomaly, result.name)
             if result == AnomalyNotificationResult.FIX:
+                from cruise_control_tpu.common.sensors import REGISTRY
+
+                record = getattr(self._notifier, "record_fix_result", None)
+                type_name = anomaly.anomaly_type.name
                 try:
                     anomaly.fix(self._facade)
-                    self._fixes[anomaly.anomaly_type.name] += 1
+                    self._fixes[type_name] += 1
                     op_log("Self-healing fix completed for %s", anomaly)
+                    if record is not None:
+                        record(anomaly.anomaly_type, True)
                 except Exception as e:
                     # fix failures surface through executor/notifier state, but
-                    # the audit trail must still record them
+                    # the audit trail must still record them — and they feed
+                    # the type's circuit breaker (degraded mode)
+                    self._fix_failures[type_name] += 1
+                    REGISTRY.meter("AnomalyDetector.fix-failures").mark()
+                    span.attributes["fixError"] = f"{type(e).__name__}: {e}"
                     op_log("Self-healing fix FAILED for %s: %r", anomaly, e)
+                    if record is not None:
+                        record(anomaly.anomaly_type, False)
             elif result == AnomalyNotificationResult.CHECK:
                 self._requeue_later(anomaly, delay_s)
             return result.name
@@ -159,10 +198,15 @@ class AnomalyDetector:
         self._threads.clear()
 
     def state(self) -> Dict:
-        return {
+        out = {
             "selfHealingEnabled": self._notifier.self_healing_enabled(),
             "anomalyCounts": dict(self._counts),
             "fixesTriggered": dict(self._fixes),
+            "fixFailures": dict(self._fix_failures),
             "recentAnomalies": list(self._recent),
             "queuedAnomalies": self._queue.qsize(),
         }
+        breakers = getattr(self._notifier, "breakers_state", None)
+        if breakers is not None:
+            out["selfHealingBreakers"] = breakers()
+        return out

@@ -5,7 +5,16 @@ to FIX / CHECK(delay) / IGNORE; SelfHealingNotifier
 (SelfHealingNotifier.java:46) adds per-type self-healing enable flags and the
 broker-failure grace-period state machine (alert threshold, then fix
 threshold, onBrokerFailure :170); WebhookNotifier posts JSON to a callable
-sink (the Slack webhook analog, egress-free)."""
+sink (the Slack webhook analog, egress-free).
+
+Degraded mode (docs/RESILIENCE.md): each anomaly type carries a
+CircuitBreaker. The anomaly handler reports every fix outcome back through
+`record_fix_result`; after `breaker_threshold` consecutive failed fixes the
+type's breaker opens and would-be FIX decisions degrade to delayed CHECKs
+(delay = remaining cooldown) until the cooldown elapses, when one half-open
+probe fix is admitted — success closes the breaker, failure re-opens it.
+This stops a persistently failing fix (a wedged cluster, a bad goal config)
+from being re-fired forever while keeping the anomaly on the queue."""
 
 from __future__ import annotations
 
@@ -13,6 +22,7 @@ import dataclasses
 import time
 from typing import Callable, Dict, Optional, Tuple
 
+from cruise_control_tpu.common.retry import CircuitBreaker
 from cruise_control_tpu.detector.anomalies import (
     Anomaly,
     AnomalyNotificationResult,
@@ -50,10 +60,61 @@ class SelfHealingNotifier(AnomalyNotifier):
     broker_failure_alert_threshold_s: float = 900.0
     self_healing_threshold_s: float = 1800.0
     alert_sink: Optional[Callable[[Dict], None]] = None
+    #: consecutive failed fixes of one anomaly type before its breaker opens
+    #: (`selfhealing.breaker.threshold`)
+    breaker_threshold: int = 3
+    #: seconds the breaker stays open before a half-open probe fix
+    #: (`selfhealing.breaker.cooldown.s`)
+    breaker_cooldown_s: float = 300.0
+    #: injectable monotonic clock (deterministic breaker tests)
+    breaker_clock: Callable[[], float] = time.monotonic
+    _breakers: Dict[str, CircuitBreaker] = dataclasses.field(
+        default_factory=dict, init=False, repr=False, compare=False
+    )
 
     def _alert(self, payload: Dict) -> None:
         if self.alert_sink is not None:
             self.alert_sink(payload)
+
+    # -- per-type circuit breakers ---------------------------------------------
+
+    def breaker(self, anomaly_type: AnomalyType) -> CircuitBreaker:
+        name = anomaly_type.name
+        br = self._breakers.get(name)
+        if br is None:
+            br = self._breakers[name] = CircuitBreaker(
+                f"SelfHealing.{name}",
+                failure_threshold=self.breaker_threshold,
+                cooldown_s=self.breaker_cooldown_s,
+                clock=self.breaker_clock,
+            )
+        return br
+
+    def record_fix_result(self, anomaly_type: AnomalyType, success: bool) -> None:
+        """Fix outcome feedback from the anomaly handler."""
+        br = self.breaker(anomaly_type)
+        if success:
+            br.record_success()
+        else:
+            br.record_failure()
+            if br.state == CircuitBreaker.OPEN:
+                self._alert({
+                    "anomalyType": anomaly_type.name,
+                    "selfHealingBreaker": br.snapshot(),
+                })
+
+    def breakers_state(self) -> Dict[str, Dict]:
+        """Snapshot of every anomaly type's breaker (for /state)."""
+        return {t.name: self.breaker(t).snapshot() for t in AnomalyType}
+
+    def _gate_fix(self, anomaly_type: AnomalyType) -> Tuple[AnomalyNotificationResult, float]:
+        """FIX if the type's breaker admits it; otherwise degrade to a
+        delayed CHECK for the remaining cooldown (floor 1s so a CHECK is
+        never an immediate-requeue busy loop)."""
+        br = self.breaker(anomaly_type)
+        if br.allow():
+            return AnomalyNotificationResult.FIX, 0.0
+        return AnomalyNotificationResult.CHECK, max(1.0, br.remaining_cooldown_s())
 
     def self_healing_enabled(self) -> Dict[str, bool]:
         return {
@@ -66,12 +127,12 @@ class SelfHealingNotifier(AnomalyNotifier):
         t = anomaly.anomaly_type
         if t == AnomalyType.GOAL_VIOLATION:
             if self.self_healing_goal_violation_enabled:
-                return AnomalyNotificationResult.FIX, 0.0
+                return self._gate_fix(t)
             return AnomalyNotificationResult.IGNORE, 0.0
         if t == AnomalyType.METRIC_ANOMALY:
             self._alert(anomaly.describe())
             if self.self_healing_metric_anomaly_enabled:
-                return AnomalyNotificationResult.FIX, 0.0
+                return self._gate_fix(t)
             return AnomalyNotificationResult.IGNORE, 0.0
         # broker failure ladder
         assert isinstance(anomaly, BrokerFailures)
@@ -85,7 +146,7 @@ class SelfHealingNotifier(AnomalyNotifier):
         if not self.self_healing_broker_failure_enabled:
             return AnomalyNotificationResult.IGNORE, 0.0
         if now_ms >= fix_at:
-            return AnomalyNotificationResult.FIX, 0.0
+            return self._gate_fix(t)
         return AnomalyNotificationResult.CHECK, max(0.0, (fix_at - now_ms) / 1000.0)
 
 

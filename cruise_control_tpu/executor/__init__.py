@@ -21,6 +21,7 @@ from cruise_control_tpu.executor.manager import ExecutionTaskManager
 from cruise_control_tpu.executor.tracker import ExecutionTaskTracker
 from cruise_control_tpu.executor.driver import ClusterDriver, SimulatorClusterDriver
 from cruise_control_tpu.executor.executor import Executor, ExecutorConfig, ExecutorState
+from cruise_control_tpu.executor.tcp_driver import TcpClusterDriver
 
 __all__ = [
     "BaseReplicaMovementStrategy",
@@ -39,4 +40,5 @@ __all__ = [
     "SimulatorClusterDriver",
     "TaskState",
     "TaskType",
+    "TcpClusterDriver",
 ]

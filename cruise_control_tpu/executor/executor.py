@@ -6,7 +6,17 @@ tasks and runs the execution loop (ProposalExecutionRunnable.execute
 throttled batches through the ClusterDriver, then leadership movements, poll
 until finished, resume sampling. Supports dynamic concurrency changes,
 user-triggered graceful stop (:433), an ExecutorNotifier hook, and the
-recently-removed/demoted broker history (:234-267)."""
+recently-removed/demoted broker history (:234-267).
+
+Resilience contract (docs/RESILIENCE.md): once an execution has started,
+`execute_proposals` never raises and never leaves a task in a non-terminal
+state. A dispatch failure kills only the failed task (the already-dispatched
+remainder keeps draining); a task that outlives `task_deadline_s` is aborted
+through the real state machine (IN_PROGRESS → ABORTING → ABORTED) and the
+batch continues; a driver that fails `max_consecutive_driver_failures` poll
+rounds in a row is declared unreachable and every in-flight task dies. The
+returned summary carries per-state counts plus the terminal-event log for
+failure attribution."""
 
 from __future__ import annotations
 
@@ -33,6 +43,33 @@ class ExecutorConfig:
     max_execution_polls: int = 100_000
     #: how long removed/demoted broker ids stay in history
     removal_history_retention_s: float = 3600.0
+    #: per-task wall-clock deadline (`executor.task.deadline.s`): a task
+    #: IN_PROGRESS longer than this is aborted (→ ABORTING → ABORTED) and
+    #: its broker slots released; 0 disables (the poll cap still bounds the
+    #: whole phase)
+    task_deadline_s: float = 0.0
+    #: consecutive failed driver poll rounds before the driver is declared
+    #: unreachable and every in-flight task is killed DEAD
+    max_consecutive_driver_failures: int = 10
+
+    @classmethod
+    def from_config(cls, config) -> "ExecutorConfig":
+        """Map `executor.*` / `num.concurrent.*` keys (config/cruise_config.py)."""
+        return cls(
+            num_concurrent_partition_movements_per_broker=config.get_int(
+                "num.concurrent.partition.movements.per.broker"
+            ),
+            num_concurrent_leader_movements=config.get_int(
+                "num.concurrent.leader.movements"
+            ),
+            execution_progress_check_interval_s=config.get_long(
+                "execution.progress.check.interval.ms"
+            ) / 1000.0,
+            removal_history_retention_s=config.get_long(
+                "removed.broker.history.retention.ms"
+            ) / 1000.0,
+            task_deadline_s=config.get_double("executor.task.deadline.s"),
+        )
 
 
 class ExecutorState:
@@ -71,6 +108,8 @@ class Executor:
         self._planner = ExecutionTaskPlanner()
         self._removed_brokers: Dict[int, float] = {}
         self._demoted_brokers: Dict[int, float] = {}
+        #: consecutive failed driver poll rounds (reset on success)
+        self._driver_failures = 0
 
     # -- state -----------------------------------------------------------------
 
@@ -138,13 +177,23 @@ class Executor:
     ) -> Dict:
         """Synchronous execution loop; the async layer wraps this in an
         OperationFuture thread. Returns the execution summary."""
+        from cruise_control_tpu.common.oplog import op_log as _op_log
+
         with self._lock:
             if self._state != ExecutorState.NO_TASK_IN_PROGRESS:
                 raise RuntimeError("an execution is already in progress")
-            if self._driver.has_ongoing_reassignment():
+            try:
+                ongoing = self._driver.has_ongoing_reassignment()
+            except Exception as e:
+                # an unreachable driver cannot veto the start; the dispatch
+                # path has its own failure handling (tasks die DEAD there)
+                _op_log("Ongoing-reassignment check failed (%r); proceeding", e)
+                ongoing = False
+            if ongoing:
                 raise RuntimeError("ongoing partition reassignment detected; refusing to start")
             self._state = ExecutorState.STARTING_EXECUTION
             self._stop_requested.clear()
+            self._driver_failures = 0
             now = self._clock()
             for b in removed_brokers or ():
                 self._removed_brokers[b] = now
@@ -169,11 +218,24 @@ class Executor:
                 self._manager.tracker.reset()  # summaries are per execution
                 self._planner.clear()
                 self._planner.add_execution_proposals(proposals, strategy=strategy, urp=urp)
-                self._run_replica_movements()
-                self._run_leadership_movements()
+                try:
+                    self._run_replica_movements()
+                    self._run_leadership_movements()
+                except Exception as e:
+                    # resilience contract: once started, execution never
+                    # raises — anything that slipped past the per-task
+                    # handling kills the in-flight remainder and falls
+                    # through to the summary
+                    span.attributes["error"] = f"{type(e).__name__}: {e}"
+                    op_log("Execution phase FAILED unexpectedly: %r", e)
+                    REGISTRY.meter("Executor.execution-phase-failures").mark()
+                    now_ms = int(self._clock() * 1000)
+                    for t in self._manager.in_flight_tasks:
+                        self._kill_task(t, now_ms, f"execution failure: {e}")
                 summary = self._manager.tracker.summary()
                 stopped = self._stop_requested.is_set()
                 span.attributes["stopped"] = stopped
+                span.attributes["byState"] = dict(summary["byState"])
                 self._notifier(
                     "execution_stopped" if stopped else "execution_finished", summary
                 )
@@ -181,29 +243,144 @@ class Executor:
                     "Execution %s: %s",
                     "stopped by user" if stopped else "finished", summary,
                 )
-                return {**summary, "stopped": stopped}
+                return {
+                    **summary,
+                    "stopped": stopped,
+                    "failedTasks": self._manager.tracker.terminal_events(
+                        only_failures=True
+                    ),
+                }
             finally:
                 if self._monitor is not None:
                     self._monitor.resume_metric_sampling()
                 with self._lock:
                     self._state = ExecutorState.NO_TASK_IN_PROGRESS
 
-    def _reap_finished(self, pending: List[ExecutionTask]) -> List[ExecutionTask]:
-        """Poll the driver once and complete any finished tasks."""
-        self._driver.poll()
-        now_ms = int(self._clock() * 1000)
+    # -- per-task terminal handling --------------------------------------------
+
+    def _on_task_terminal(self, task: ExecutionTask) -> None:
+        """ExecutionTask listener: every terminal transition lands in the
+        tracker's terminal log, the sensors, and the ExecutorNotifier
+        (`task_completed` / `task_aborted` / `task_dead`)."""
+        from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        state = task.state.name.lower()
+        REGISTRY.meter(f"Executor.task-{state}").mark()
+        self._manager.tracker.record_terminal(task)
+        info = {
+            "executionId": task.execution_id,
+            "type": task.task_type.name,
+            "startTimeMs": task.start_time_ms,
+            "endTimeMs": task.end_time_ms,
+            "reason": task.terminal_reason,
+        }
+        self._notifier(f"task_{state}", info)
+        if task.state != TaskState.COMPLETED:
+            op_log(
+                "Task %d %s: %s", task.execution_id, task.state.name,
+                task.terminal_reason or "unattributed",
+            )
+
+    def _kill_task(self, task: ExecutionTask, now_ms: int, reason: str) -> None:
+        """Force a task to DEAD through the state machine and free its slots."""
+        try:
+            if task.state == TaskState.PENDING:
+                task.in_progress(now_ms)
+            if task.state == TaskState.IN_PROGRESS or task.state == TaskState.ABORTING:
+                task.kill(now_ms, reason=reason)
+        except ValueError:
+            pass  # already terminal (a racing completion won)
+        self._manager.mark_done(task)
+
+    def _expire_deadlines(
+        self, pending: List[ExecutionTask], now_ms: int
+    ) -> List[ExecutionTask]:
+        """Abort tasks whose wall-clock deadline expired (IN_PROGRESS →
+        ABORTING → ABORTED); the agent may still finish the movement later —
+        the executor just stops holding broker slots for it."""
+        deadline_ms = self._config.task_deadline_s * 1000.0
+        if deadline_ms <= 0:
+            return pending
+        from cruise_control_tpu.common.sensors import REGISTRY
+
         still = []
         for t in pending:
-            if self._driver.is_finished(t):
-                t.completed(now_ms)
+            if now_ms - (t.start_time_ms or 0) >= deadline_ms:
+                REGISTRY.meter("Executor.task-deadline-expired").mark()
+                t.abort(reason=f"deadline ({self._config.task_deadline_s:g}s) expired")
+                t.aborted(now_ms)
                 self._manager.mark_done(t)
             else:
                 still.append(t)
         return still
 
+    def _reap_finished(self, pending: List[ExecutionTask]) -> List[ExecutionTask]:
+        """Poll the driver once: complete finished tasks, expire deadlines,
+        and — after `max_consecutive_driver_failures` failed poll rounds —
+        declare the driver unreachable and kill everything in flight."""
+        from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        now_ms = int(self._clock() * 1000)
+        try:
+            self._driver.poll()
+            self._driver_failures = 0
+        except Exception as e:
+            self._driver_failures += 1
+            REGISTRY.meter("Executor.driver-poll-failures").mark()
+            if self._driver_failures >= self._config.max_consecutive_driver_failures:
+                op_log(
+                    "Cluster driver unreachable after %d consecutive poll "
+                    "failures (%r); killing %d in-flight task(s)",
+                    self._driver_failures, e, len(pending),
+                )
+                for t in pending:
+                    self._kill_task(t, now_ms, f"driver unreachable: {e}")
+                return []
+            return self._expire_deadlines(list(pending), now_ms)
+        still = []
+        for t in pending:
+            try:
+                finished = self._driver.is_finished(t)
+            except Exception:
+                finished = False
+            if finished:
+                t.completed(now_ms)
+                self._manager.mark_done(t)
+            else:
+                still.append(t)
+        return self._expire_deadlines(still, now_ms)
+
+    def _dispatch_batch(
+        self,
+        batch: List[ExecutionTask],
+        start_fn: Callable[[ExecutionTask], None],
+    ) -> List[ExecutionTask]:
+        """Mark a batch IN_PROGRESS and dispatch each task, isolating
+        per-task dispatch failures: a task whose dispatch raises dies DEAD
+        and releases its slots; the rest of the batch proceeds."""
+        from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+
+        now_ms = int(self._clock() * 1000)
+        for t in batch:
+            t.listener = self._on_task_terminal
+        self._manager.mark_in_progress(batch, now_ms)
+        live = []
+        for t in batch:
+            try:
+                start_fn(t)
+                live.append(t)
+            except Exception as e:
+                REGISTRY.meter("Executor.dispatch-failures").mark()
+                op_log("Dispatch FAILED for task %d: %r", t.execution_id, e)
+                self._kill_task(t, now_ms, f"dispatch failure: {e}")
+        return live
+
     def _wait_for_tasks(self, tasks: List[ExecutionTask]) -> None:
         polls = 0
-        pending = list(tasks)
+        pending = [t for t in tasks if not t.done]
         while pending:
             pending = self._reap_finished(pending)
             if not pending:
@@ -212,9 +389,11 @@ class Executor:
             if polls > self._config.max_execution_polls:
                 now_ms = int(self._clock() * 1000)
                 for t in pending:
-                    t.kill(now_ms)
-                    self._manager.mark_done(t)
-                raise TimeoutError(f"{len(pending)} execution task(s) never finished")
+                    self._kill_task(
+                        t, now_ms,
+                        f"poll cap ({self._config.max_execution_polls}) exhausted",
+                    )
+                break
             # graceful stop still waits for in-flight work — at normal pace,
             # not a busy spin
             time.sleep(self._config.execution_progress_check_interval_s)
@@ -259,12 +438,11 @@ class Executor:
                             "executor.batch-dispatch", kind="executor",
                             tasks=len(batch), type="replica",
                         ), REGISTRY.histogram("Executor.batch-dispatch-timer"):
-                            now_ms = int(self._clock() * 1000)
-                            self._manager.mark_in_progress(batch, now_ms)
-                            for t in batch:
-                                self._driver.start_replica_movement(t)
+                            live = self._dispatch_batch(
+                                batch, self._driver.start_replica_movement
+                            )
                         batches += 1
-                        in_flight.extend(batch)
+                        in_flight.extend(live)
                 elif not in_flight:
                     break
                 if in_flight:
@@ -272,9 +450,12 @@ class Executor:
                     if polls > self._config.max_execution_polls:
                         now_ms = int(self._clock() * 1000)
                         for t in in_flight:
-                            t.kill(now_ms)
-                            self._manager.mark_done(t)
-                        raise TimeoutError(f"{len(in_flight)} execution task(s) never finished")
+                            self._kill_task(
+                                t, now_ms,
+                                f"poll cap ({self._config.max_execution_polls}) exhausted",
+                            )
+                        in_flight = []
+                        continue
                     time.sleep(self._config.execution_progress_check_interval_s)
             span.attributes["batches"] = batches
 
@@ -301,8 +482,7 @@ class Executor:
                     "executor.batch-dispatch", kind="executor",
                     tasks=len(batch), type="leadership",
                 ), REGISTRY.histogram("Executor.batch-dispatch-timer"):
-                    now_ms = int(self._clock() * 1000)
-                    self._manager.mark_in_progress(batch, now_ms)
-                    for t in batch:
-                        self._driver.start_leadership_movement(t)
-                self._wait_for_tasks(batch)
+                    live = self._dispatch_batch(
+                        batch, self._driver.start_leadership_movement
+                    )
+                self._wait_for_tasks(live)

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Optional
+from typing import Callable, Optional
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
 
@@ -43,6 +43,10 @@ _VALID_TRANSFER = {
 }
 
 
+#: terminal states always carry an end_time_ms and fire the task's listener
+TERMINAL_STATES = frozenset({TaskState.COMPLETED, TaskState.ABORTED, TaskState.DEAD})
+
+
 @dataclasses.dataclass
 class ExecutionTask:
     execution_id: int
@@ -51,30 +55,47 @@ class ExecutionTask:
     state: TaskState = TaskState.PENDING
     start_time_ms: Optional[int] = None
     end_time_ms: Optional[int] = None
+    #: why the task reached a terminal state ("", "deadline", "dispatch
+    #: failure: ...", "driver unreachable", ...) — failure attribution for
+    #: the execution summary and op_log
+    terminal_reason: str = ""
+    #: invoked once, with the task, when it enters a terminal state; the
+    #: executor wires this to its ExecutorNotifier + tracker
+    listener: Optional[Callable[["ExecutionTask"], None]] = dataclasses.field(
+        default=None, repr=False, compare=False
+    )
 
     def _transfer(self, target: TaskState) -> None:
         if target not in _VALID_TRANSFER[self.state]:
             raise ValueError(f"illegal transition {self.state.name} -> {target.name}")
         self.state = target
+        if target in TERMINAL_STATES and self.listener is not None:
+            self.listener(self)
 
     def in_progress(self, now_ms: int = 0) -> None:
-        self._transfer(TaskState.IN_PROGRESS)
         self.start_time_ms = now_ms
+        self._transfer(TaskState.IN_PROGRESS)
 
     def completed(self, now_ms: int = 0) -> None:
-        self._transfer(TaskState.COMPLETED)
         self.end_time_ms = now_ms
+        self._transfer(TaskState.COMPLETED)
 
-    def abort(self) -> None:
+    def abort(self, reason: str = "") -> None:
+        if reason:
+            self.terminal_reason = reason
         self._transfer(TaskState.ABORTING)
 
-    def aborted(self, now_ms: int = 0) -> None:
+    def aborted(self, now_ms: int = 0, reason: str = "") -> None:
+        self.end_time_ms = now_ms
+        if reason:
+            self.terminal_reason = reason
         self._transfer(TaskState.ABORTED)
-        self.end_time_ms = now_ms
 
-    def kill(self, now_ms: int = 0) -> None:
-        self._transfer(TaskState.DEAD)
+    def kill(self, now_ms: int = 0, reason: str = "") -> None:
         self.end_time_ms = now_ms
+        if reason:
+            self.terminal_reason = reason
+        self._transfer(TaskState.DEAD)
 
     @property
     def done(self) -> bool:

@@ -48,14 +48,18 @@ from __future__ import annotations
 import json
 import socket
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Callable, Dict, List, Optional, Set
 
+from cruise_control_tpu.common.retry import RetryPolicy
 from cruise_control_tpu.executor.driver import ClusterDriver
 from cruise_control_tpu.executor.task import ExecutionTask
 
 
 class AgentProtocolError(RuntimeError):
-    """The agent rejected a request or broke the line protocol."""
+    """The agent rejected a request or broke the line protocol.
+
+    Deliberately NOT in the retryable set: the agent parsed the request and
+    refused it, so re-sending the same bytes cannot change the answer."""
 
 
 class _LineClient:
@@ -68,7 +72,8 @@ class _LineClient:
     context with load_verify_locations on the agent's own cert)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0,
-                 ssl_context=None, server_hostname: Optional[str] = None):
+                 ssl_context=None, server_hostname: Optional[str] = None,
+                 fault_hook: Optional[Callable[[Dict], None]] = None):
         self._addr = (host, port)
         self._timeout = timeout_s
         self._ssl_context = ssl_context
@@ -76,6 +81,9 @@ class _LineClient:
         self._sock: Optional[socket.socket] = None
         self._rfile = None
         self._lock = threading.Lock()
+        #: test-only client-side fault injection (testing/faults.py): called
+        #: with the payload before each send; may raise ConnectionError/delay
+        self._fault_hook = fault_hook
 
     def _connect(self) -> None:
         sock = socket.create_connection(self._addr, timeout=self._timeout)
@@ -96,6 +104,8 @@ class _LineClient:
         with self._lock:
             for attempt in (0, 1):
                 try:
+                    if self._fault_hook is not None:
+                        self._fault_hook(payload)
                     if self._sock is None:
                         self._connect()
                     self._sock.sendall(json.dumps(payload).encode() + b"\n")
@@ -123,15 +133,33 @@ class _LineClient:
 
 
 class TcpClusterDriver(ClusterDriver):
-    """Executor binding over the cluster-agent wire protocol above."""
+    """Executor binding over the cluster-agent wire protocol above.
+
+    Every op runs under `retry_policy` with reconnect-on-failure: the
+    _LineClient drops its socket on any transport error, so the next attempt
+    re-dials from scratch. ALL five ops are safely retryable — `finished`/
+    `ongoing`/`ping` are pure reads, and `reassign`/`leader` are idempotent
+    by protocol because they are keyed on executionId (re-sending the same
+    executionId overwrites the agent's pending entry for it, it does not
+    start a second movement)."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 10.0,
-                 ssl_context=None, server_hostname: Optional[str] = None):
+                 ssl_context=None, server_hostname: Optional[str] = None,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 fault_hook: Optional[Callable[[Dict], None]] = None):
         self._client = _LineClient(host, port, timeout_s, ssl_context=ssl_context,
-                                   server_hostname=server_hostname)
+                                   server_hostname=server_hostname,
+                                   fault_hook=fault_hook)
+        self._retry = retry_policy or RetryPolicy()
         self._finished: Set[int] = set()
         self._in_flight: Dict[int, ExecutionTask] = {}
         self._lock = threading.Lock()
+
+    def _request(self, payload: Dict) -> Dict:
+        op = payload.get("op", "op")
+        return self._retry.call(
+            lambda: self._client.request(payload), name=f"TcpDriver.{op}"
+        )
 
     def _entry(self, task: ExecutionTask) -> Dict:
         p = task.proposal
@@ -148,7 +176,7 @@ class TcpClusterDriver(ClusterDriver):
             **self._entry(task),
             "replicas": list(task.proposal.new_replicas),
         }
-        self._client.request(req)
+        self._request(req)
         with self._lock:
             self._in_flight[task.execution_id] = task
 
@@ -158,7 +186,7 @@ class TcpClusterDriver(ClusterDriver):
             **self._entry(task),
             "leader": task.proposal.new_leader,
         }
-        self._client.request(req)
+        self._request(req)
         with self._lock:
             self._in_flight[task.execution_id] = task
 
@@ -170,7 +198,7 @@ class TcpClusterDriver(ClusterDriver):
             ids = list(self._in_flight)
         if not ids:
             return
-        resp = self._client.request({"op": "finished", "executionIds": ids})
+        resp = self._request({"op": "finished", "executionIds": ids})
         done = set(resp.get("finished", ()))
         with self._lock:
             self._finished |= done
@@ -185,7 +213,7 @@ class TcpClusterDriver(ClusterDriver):
         return False
 
     def has_ongoing_reassignment(self) -> bool:
-        resp = self._client.request({"op": "ongoing"})
+        resp = self._request({"op": "ongoing"})
         return bool(resp.get("ongoing"))
 
     def close(self) -> None:

@@ -1,26 +1,56 @@
 """Task-state aggregation for observability.
 
 Analog of ExecutionTaskTracker (cc/executor/ExecutionTaskTracker.java):
-counts by (type, state) for the /state endpoint and sensors."""
+counts by (type, state) for the /state endpoint and sensors, plus a
+per-execution terminal-event log (executionId, state, start/end times,
+reason) so the summary and op_log can attribute WHICH tasks died and why."""
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List
 
 from cruise_control_tpu.executor.task import ExecutionTask, TaskState, TaskType
+
+#: terminal events kept per execution (ABORTED/DEAD first, so failures are
+#: never truncated away by a large completed count)
+_MAX_TERMINAL_EVENTS = 200
 
 
 class ExecutionTaskTracker:
     def __init__(self):
         self._latest: Dict[int, ExecutionTask] = {}
+        self._terminal_events: List[Dict] = []
 
     def observe(self, task: ExecutionTask) -> None:
         self._latest[task.execution_id] = task
+
+    def record_terminal(self, task: ExecutionTask) -> None:
+        """One terminal transition (COMPLETED/ABORTED/DEAD), with timing and
+        reason — wired from the ExecutionTask listener."""
+        self._latest[task.execution_id] = task
+        if len(self._terminal_events) < _MAX_TERMINAL_EVENTS:
+            self._terminal_events.append({
+                "executionId": task.execution_id,
+                "type": task.task_type.name,
+                "state": task.state.name,
+                "startTimeMs": task.start_time_ms,
+                "endTimeMs": task.end_time_ms,
+                "reason": task.terminal_reason,
+            })
+
+    def terminal_events(self, only_failures: bool = False) -> List[Dict]:
+        if only_failures:
+            return [
+                e for e in self._terminal_events
+                if e["state"] != TaskState.COMPLETED.name
+            ]
+        return list(self._terminal_events)
 
     def reset(self) -> None:
         """Drop prior-execution tasks (summaries are per execution; without
         this, a long-lived service accumulates every task ever run)."""
         self._latest.clear()
+        self._terminal_events.clear()
 
     def counts(self) -> Dict[str, Dict[str, int]]:
         out = {
@@ -30,8 +60,11 @@ class ExecutionTaskTracker:
             out[task.task_type.name][task.state.name] += 1
         return out
 
-    def summary(self) -> Dict[str, int]:
+    def summary(self) -> Dict:
         c = self.counts()
+        by_state = {
+            s.name: sum(v[s.name] for v in c.values()) for s in TaskState
+        }
         return {
             "numTotalMovements": sum(sum(v.values()) for v in c.values()),
             "numFinishedMovements": sum(
@@ -42,4 +75,5 @@ class ExecutionTaskTracker:
             "numAbortedOrDead": sum(
                 v[TaskState.ABORTED.name] + v[TaskState.DEAD.name] for v in c.values()
             ),
+            "byState": by_state,
         }

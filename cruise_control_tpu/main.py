@@ -35,13 +35,16 @@ def build_simulated_service(
     `config_path`: optional cruisecontrol.properties — the analyzer keys
     (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`
     and the bulk count-planner knobs) map onto the goal engine through
-    BalancingConstraint.from_config / OptimizerSettings.from_config, and the
+    BalancingConstraint.from_config / OptimizerSettings.from_config, the
     `observability.*` keys configure the span tracer (ring size, JSONL sink)
-    and arm the one-shot profiler capture (docs/OBSERVABILITY.md)."""
+    and arm the one-shot profiler capture (docs/OBSERVABILITY.md), and the
+    resilience keys (`executor.task.deadline.s`, `executor.retry.*`,
+    `selfhealing.breaker.*`) shape the executor deadline/retry behavior and
+    the self-healing circuit breakers (docs/RESILIENCE.md)."""
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
     from cruise_control_tpu.async_ops import AsyncCruiseControl
     from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier
-    from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+    from cruise_control_tpu.executor import Executor, ExecutorConfig, SimulatorClusterDriver
     from cruise_control_tpu.facade import CruiseControl, FacadeConfig
     from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
     from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
@@ -79,8 +82,9 @@ def build_simulated_service(
         ),
     )
     runner = LoadMonitorTaskRunner(monitor)
-    executor = Executor(SimulatorClusterDriver(sim, latency_polls=2), load_monitor=monitor)
     optimizer = GoalOptimizer()
+    executor_config = ExecutorConfig()
+    notifier = SelfHealingNotifier()
     if config_path:
         from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
         from cruise_control_tpu.config.balancing import BalancingConstraint
@@ -92,6 +96,15 @@ def build_simulated_service(
             constraint=BalancingConstraint.from_config(cfg),
             settings=OptimizerSettings.from_config(cfg),
         )
+        # resilience keys (docs/RESILIENCE.md): executor deadlines/concurrency
+        # and the self-healing breaker ladder. The simulator driver needs no
+        # retry policy; a TcpClusterDriver deployment builds its RetryPolicy
+        # from the same config (RetryPolicy.from_config).
+        executor_config = ExecutorConfig.from_config(cfg)
+        notifier = SelfHealingNotifier(
+            breaker_threshold=cfg.get_int("selfhealing.breaker.threshold"),
+            breaker_cooldown_s=cfg.get_double("selfhealing.breaker.cooldown.s"),
+        )
         from cruise_control_tpu.common import tracing
 
         tracing.TRACER.configure(
@@ -99,6 +112,10 @@ def build_simulated_service(
             jsonl_path=cfg.get_string("observability.trace.jsonl.path") or None,
         )
         tracing.set_profile_dir(cfg.get_string("observability.profile.dir") or None)
+    executor = Executor(
+        SimulatorClusterDriver(sim, latency_polls=2),
+        config=executor_config, load_monitor=monitor,
+    )
     facade = CruiseControl(
         monitor, executor, optimizer=optimizer,
         config=FacadeConfig(
@@ -106,7 +123,7 @@ def build_simulated_service(
         ),
     )
     acc = AsyncCruiseControl(facade)
-    detector = AnomalyDetector(facade, notifier=SelfHealingNotifier())
+    detector = AnomalyDetector(facade, notifier=notifier)
     app = CruiseControlApp(
         acc, anomaly_detector=detector, two_step_verification=two_step_verification,
         webui_dir=webui_dir, webui_prefix=webui_prefix,

@@ -7,6 +7,7 @@ transport and accepts executor operations, so the full
 reporter -> monitor -> analyzer -> executor loop runs without Kafka.
 """
 
+from cruise_control_tpu.testing.faults import FaultPlan, FaultRule
 from cruise_control_tpu.testing.simulator import SimulatedCluster
 
-__all__ = ["SimulatedCluster"]
+__all__ = ["FaultPlan", "FaultRule", "SimulatedCluster"]

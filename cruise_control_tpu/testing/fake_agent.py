@@ -28,11 +28,16 @@ class FakeClusterAgent:
     Kafka agent serves on; only the dispatch differs."""
 
     def __init__(self, sim, latency_polls: int = 0, host: str = "127.0.0.1",
-                 ssl_context=None):
+                 ssl_context=None, fault_plan=None):
+        """`fault_plan` (testing.faults.FaultPlan): injected faults consulted
+        before dispatch (fail/drop/delay) and when recording movements
+        (never_finish)."""
         self._sim = sim
         self._latency = latency_polls
+        self._faults = fault_plan
         self._lock = threading.Lock()
-        #: executionId -> (kind, payload, remaining_probes)
+        #: executionId -> (kind, payload, remaining_probes); remaining < 0
+        #: means the movement NEVER completes (injected hung controller)
         self._pending: Dict[int, Tuple[str, Dict, int]] = {}
         self._finished: set = set()
         self._metrics: list = []  # hex-encoded records, consumed by poll
@@ -55,18 +60,19 @@ class FakeClusterAgent:
     # -- protocol ops ----------------------------------------------------------
 
     def _dispatch(self, req: Dict) -> Dict:
+        if self._faults is not None:
+            injected = self._faults.server_intercept(req)
+            if injected is not None:
+                return injected
         op = req.get("op")
         if op == "ping":
             return {"ok": True}
-        if op == "reassign":
+        if op in ("reassign", "leader"):
+            latency = self._latency
+            if self._faults is not None and self._faults.never_finishes(req):
+                latency = -1
             with self._lock:
-                self._pending[int(req["executionId"])] = (
-                    "reassign", req, self._latency
-                )
-            return {"ok": True}
-        if op == "leader":
-            with self._lock:
-                self._pending[int(req["executionId"])] = ("leader", req, self._latency)
+                self._pending[int(req["executionId"])] = (op, req, latency)
             return {"ok": True}
         if op == "finished":
             done = []
@@ -80,6 +86,8 @@ class FakeClusterAgent:
                     if entry is None:
                         continue  # unknown id (restarted driver): unfinished
                     kind, payload, remaining = entry
+                    if remaining < 0:
+                        continue  # injected never-finishing movement
                     if remaining > 0:
                         self._pending[eid] = (kind, payload, remaining - 1)
                         continue

@@ -11,7 +11,9 @@ Output (stdout):
   1. per-span-kind latency table from /trace's summary, ranked by total time
      (count, total, mean, p50/p95/p99, max),
   2. the slowest recent spans with their attributes (engine, rounds, goal),
-  3. sensor histograms/timers from /metrics, ranked by total seconds.
+  3. sensor histograms/timers from /metrics, ranked by total seconds,
+  4. the resilience picture: self-healing circuit-breaker states and the
+     retry/dead-task/dispatch-failure counters (docs/RESILIENCE.md).
 
 --raw additionally prints the raw Prometheus exposition text.
 """
@@ -95,6 +97,57 @@ def _parse_prometheus_latencies(text: str) -> dict:
     return out
 
 
+def _parse_labels(labels_raw: str) -> dict:
+    out = {}
+    for part in labels_raw.split('",'):
+        k, _, v = part.partition('="')
+        out[k.strip(", ")] = v.rstrip('"')
+    return out
+
+
+#: CircuitBreaker.STATE_CODES, inverted (kept literal: this script must run
+#: against a remote instance without importing the package)
+_BREAKER_STATES = {0: "closed", 1: "half_open", 2: "open"}
+
+#: meter-name markers that belong in the resilience section
+_RESILIENCE_MARKERS = (
+    "Retry.", "CircuitBreaker.", "Executor.task-", "Executor.dispatch-",
+    "Executor.driver-", "Executor.execution-phase-failures",
+    "AnomalyDetector.fix-failures",
+)
+
+
+def _resilience_section(text: str) -> None:
+    breakers = {}
+    meters = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        labels = _parse_labels(labels_raw)
+        sensor = labels.get("sensor", "")
+        if name == "cruise_control_gauge" and sensor.endswith("breaker-state"):
+            code = int(float(value))
+            breakers[labels.get("field", sensor)] = _BREAKER_STATES.get(
+                code, f"code={code}"
+            )
+        elif name == "cruise_control_meter_total" and any(
+            m in sensor for m in _RESILIENCE_MARKERS
+        ):
+            meters[sensor] = int(float(value))
+    print("\n== resilience (breakers + retry/failure counters) ==")
+    if breakers:
+        for anomaly_type, state in sorted(breakers.items()):
+            marker = "!!" if state != "closed" else "  "
+            print(f"{marker} breaker {anomaly_type:<20} {state}")
+    else:
+        print("   (no breaker gauge exported)")
+    for sensor, count in sorted(meters.items(), key=lambda kv: -kv[1]):
+        if count:
+            print(f"   {sensor:<52} {count:>8}")
+
+
 def _sensor_table(text: str) -> None:
     latencies = _parse_prometheus_latencies(text)
     print("\n== sensors (ranked by total seconds) ==")
@@ -124,6 +177,7 @@ def main() -> int:
     _span_kind_table(trace.get("summary", {}))
     _slow_spans(trace.get("spans", []))
     _sensor_table(metrics_text)
+    _resilience_section(metrics_text)
     print(f"\ntracer overhead: {trace.get('overheadS', 0.0):.6f}s")
     if args.raw:
         print("\n== raw /metrics ==")

@@ -1,0 +1,486 @@
+"""Execution resilience layer tests (docs/RESILIENCE.md).
+
+Every integration case drives the REAL protocol stack — TcpClusterDriver
+over a socket to FakeClusterAgent — with faults injected through
+testing.faults.FaultPlan, not mocks: a flaky agent (drops, transient
+failures), a dead agent, a never-finishing movement, and a self-healing fix
+that fails repeatedly. The unit tier pins RetryPolicy/CircuitBreaker
+semantics under a deterministic clock."""
+
+import socket
+import threading
+
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.retry import (
+    CircuitBreaker,
+    RetryExhaustedError,
+    RetryPolicy,
+)
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.detector.anomalies import Anomaly, AnomalyType
+from cruise_control_tpu.detector.anomaly_detector import AnomalyDetector
+from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+from cruise_control_tpu.executor import (
+    ExecutionTask,
+    Executor,
+    ExecutorConfig,
+    SimulatorClusterDriver,
+    TaskState,
+    TaskType,
+    TcpClusterDriver,
+)
+from cruise_control_tpu.models.generators import unbalanced
+from cruise_control_tpu.testing.fake_agent import FakeClusterAgent
+from cruise_control_tpu.testing.faults import FaultPlan, FaultRule
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+
+def proposal(p, old, new, mb=0.0):
+    return ExecutionProposal(partition=p, old_replicas=old, new_replicas=new,
+                             data_to_move_mb=mb)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+        self.sleeps = []
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.sleeps.append(s)
+        self.t += s
+
+
+def meter_count(name):
+    return REGISTRY.meter(name).count
+
+
+# -- RetryPolicy (deterministic clock) -----------------------------------------
+
+
+def test_retry_policy_recovers_with_exponential_backoff():
+    clock = FakeClock()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("boom")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, backoff_s=0.1, max_backoff_s=10.0,
+                         clock=clock, sleep=clock.sleep)
+    before = meter_count("Retry.t1.recoveries")
+    assert policy.call(flaky, name="t1") == "ok"
+    assert len(calls) == 3
+    assert clock.sleeps == [0.1, 0.2]  # exponential ladder
+    assert meter_count("Retry.t1.recoveries") == before + 1
+
+
+def test_retry_policy_exhaustion_chains_last_error():
+    clock = FakeClock()
+    policy = RetryPolicy(max_attempts=3, backoff_s=0.01, clock=clock,
+                         sleep=clock.sleep)
+    before = meter_count("Retry.t2.exhausted")
+    with pytest.raises(RetryExhaustedError) as ei:
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError("dead")), name="t2")
+    assert isinstance(ei.value.__cause__, ConnectionError)
+    assert meter_count("Retry.t2.exhausted") == before + 1
+
+
+def test_retry_policy_non_retryable_raises_immediately():
+    calls = []
+
+    def reject():
+        calls.append(1)
+        raise ValueError("protocol rejection")
+
+    policy = RetryPolicy(max_attempts=5, backoff_s=0.01)
+    with pytest.raises(ValueError):
+        policy.call(reject, name="t3")
+    assert len(calls) == 1
+
+
+def test_retry_policy_deadline_cuts_retries_short():
+    clock = FakeClock()
+    calls = []
+
+    def always_fail():
+        calls.append(1)
+        raise ConnectionError("x")
+
+    # backoff 1.0 + 2.0 would exceed the 1.5s deadline before attempt 3
+    policy = RetryPolicy(max_attempts=10, backoff_s=1.0, max_backoff_s=8.0,
+                         deadline_s=1.5, clock=clock, sleep=clock.sleep)
+    with pytest.raises(RetryExhaustedError):
+        policy.call(always_fail, name="t4")
+    assert len(calls) == 2  # first try + the one retry that fit the deadline
+
+
+def test_retry_backoff_ceiling():
+    policy = RetryPolicy(backoff_s=0.5, max_backoff_s=1.0)
+    assert policy.backoff_for(0) == 0.5
+    assert policy.backoff_for(5) == 1.0
+
+
+# -- CircuitBreaker (deterministic clock) --------------------------------------
+
+
+def test_circuit_breaker_full_cycle():
+    clock = FakeClock()
+    br = CircuitBreaker("test-cycle", failure_threshold=2, cooldown_s=30.0,
+                        clock=clock)
+    assert br.state == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED  # below threshold
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.allow()
+    assert br.remaining_cooldown_s() == pytest.approx(30.0)
+
+    clock.t += 31.0
+    assert br.state == CircuitBreaker.HALF_OPEN  # cooldown elapsed
+    assert br.allow()          # the probe
+    assert not br.allow()      # only one probe at a time
+    br.record_success()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.allow()
+
+    # a failed half-open probe re-opens immediately (no threshold wait)
+    br.record_failure()
+    br.record_failure()
+    clock.t += 31.0
+    assert br.allow()
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert br.snapshot()["opens"] == 3
+
+
+# -- flaky agent: transport drops are retried through reconnect ----------------
+
+
+def _tcp_setup(faults=None, latency_polls=1, attempts=4, deadline_s=0.0,
+               max_polls=100_000):
+    sim = SimulatedCluster(unbalanced())
+    agent = FakeClusterAgent(sim, latency_polls=latency_polls,
+                             fault_plan=faults).start()
+    driver = TcpClusterDriver(
+        *agent.address, timeout_s=2.0,
+        retry_policy=RetryPolicy(max_attempts=attempts, backoff_s=0.001,
+                                 max_backoff_s=0.005),
+    )
+    events = []
+    execu = Executor(
+        driver,
+        config=ExecutorConfig(execution_progress_check_interval_s=0.01,
+                              task_deadline_s=deadline_s,
+                              max_execution_polls=max_polls),
+        notifier=lambda event, info: events.append((event, info)),
+    )
+    return sim, agent, execu, events
+
+
+def test_flaky_agent_execution_completes_with_retries():
+    faults = FaultPlan([
+        FaultRule(op="reassign", action="drop", times=2),
+        FaultRule(op="finished", action="drop", times=1),
+    ])
+    sim, agent, execu, events = _tcp_setup(faults=faults)
+    retries_before = meter_count("Retry.TcpDriver.reassign.retries")
+    try:
+        result = execu.execute_proposals(
+            [proposal(0, (0, 1), (2, 1)), proposal(2, (0, 2), (2, 0))]
+        )
+    finally:
+        agent.stop()
+    assert result["byState"][TaskState.COMPLETED.name] == 2
+    assert result["byState"][TaskState.DEAD.name] == 0
+    assert result["failedTasks"] == []
+    assert sim.has_partition(0, 2) and not sim.has_partition(0, 0)
+    # the drops really fired and the retry layer really recovered
+    assert any(f["action"] == "drop" for f in faults.fired)
+    assert meter_count("Retry.TcpDriver.reassign.retries") > retries_before
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_agent_rejection_kills_only_that_task():
+    """'fail' is a protocol-level rejection: NOT retried, and it must kill
+    only the rejected task — the rest of the batch keeps going (the
+    mid-batch stranding fix)."""
+    faults = FaultPlan([FaultRule(op="reassign", action="fail", times=1,
+                                  error="quota exceeded")])
+    sim, agent, execu, events = _tcp_setup(faults=faults)
+    try:
+        result = execu.execute_proposals(
+            [proposal(0, (0, 1), (2, 1)), proposal(1, (0, 2), (1, 2))]
+        )
+    finally:
+        agent.stop()
+    assert result["byState"][TaskState.DEAD.name] == 1
+    assert result["byState"][TaskState.COMPLETED.name] == 1
+    (failed,) = result["failedTasks"]
+    assert failed["state"] == "DEAD"
+    assert "dispatch failure" in failed["reason"]
+    assert failed["endTimeMs"] is not None
+    # broker slots were released: a fresh execution can start immediately
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+    assert any(e == "task_dead" for e, _ in events)
+
+
+def test_dead_agent_returns_all_dead_summary():
+    """No agent listening at all: execute_proposals never raises, every task
+    dies DEAD, and the executor returns to NO_TASK_IN_PROGRESS."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()  # nothing listens here
+    driver = TcpClusterDriver(
+        "127.0.0.1", port, timeout_s=0.2,
+        retry_policy=RetryPolicy(max_attempts=2, backoff_s=0.001),
+    )
+    events = []
+    execu = Executor(
+        driver,
+        config=ExecutorConfig(execution_progress_check_interval_s=0.005,
+                              max_consecutive_driver_failures=2),
+        notifier=lambda event, info: events.append((event, info)),
+    )
+    result = execu.execute_proposals(
+        [proposal(0, (0, 1), (2, 1)), proposal(2, (0, 2), (2, 0))]
+    )
+    assert result["byState"][TaskState.DEAD.name] == 2
+    assert result["numFinishedMovements"] == result["numTotalMovements"] == 2
+    assert all(f["state"] == "DEAD" for f in result["failedTasks"])
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+    assert sum(1 for e, _ in events if e == "task_dead") == 2
+
+
+def test_never_finishing_task_hits_deadline_others_complete():
+    faults = FaultPlan([FaultRule(op="reassign", action="never_finish",
+                                  times=1, partition=0)])
+    sim, agent, execu, events = _tcp_setup(faults=faults, deadline_s=0.15)
+    try:
+        result = execu.execute_proposals(
+            [proposal(0, (0, 1), (2, 1)),   # hung movement
+             proposal(1, (0, 2), (1, 2)),   # completes
+             proposal(2, (0, 2), (2, 0))]   # leadership, completes
+        )
+    finally:
+        agent.stop()
+    assert result["byState"][TaskState.ABORTED.name] == 1
+    assert result["byState"][TaskState.COMPLETED.name] == 2
+    (failed,) = result["failedTasks"]
+    assert failed["state"] == "ABORTED" and "deadline" in failed["reason"]
+    assert any(e == "task_aborted" for e, _ in events)
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_poll_cap_exhaustion_returns_summary_not_raise():
+    sim = SimulatedCluster(unbalanced())
+    execu = Executor(
+        SimulatorClusterDriver(sim, latency_polls=50),
+        config=ExecutorConfig(execution_progress_check_interval_s=0.001,
+                              max_execution_polls=3),
+    )
+    result = execu.execute_proposals([proposal(0, (0, 1), (2, 1))])
+    assert result["byState"][TaskState.DEAD.name] == 1
+    assert "poll cap" in result["failedTasks"][0]["reason"]
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_terminal_transitions_record_end_time_and_fire_listener():
+    seen = []
+    t = ExecutionTask(7, proposal(0, (0, 1), (2, 1)),
+                      TaskType.INTER_BROKER_REPLICA_ACTION,
+                      listener=seen.append)
+    t.in_progress(5)
+    t.abort(reason="deadline")
+    assert seen == []  # ABORTING is not terminal
+    t.aborted(9)
+    assert seen == [t] and t.end_time_ms == 9 and t.terminal_reason == "deadline"
+
+    t2 = ExecutionTask(8, proposal(1, (0,), (1,)),
+                       TaskType.INTER_BROKER_REPLICA_ACTION,
+                       listener=seen.append)
+    t2.in_progress(1)
+    t2.kill(4, reason="dispatch failure: x")
+    assert t2 in seen and t2.end_time_ms == 4
+
+
+# -- self-healing circuit breaker ----------------------------------------------
+
+
+class _FlakyFixAnomaly(Anomaly):
+    anomaly_type = AnomalyType.GOAL_VIOLATION
+
+    def __init__(self, controller):
+        self._controller = controller
+
+    def fix(self, facade):
+        self._controller["attempts"] += 1
+        if self._controller["failing"]:
+            raise RuntimeError("fix wedged")
+        return "fixed"
+
+    def describe(self):
+        return {"anomalyType": self.anomaly_type.name}
+
+
+class _StubDetector:
+    def detect(self):
+        return None
+
+
+class _StubFacade:
+    class _StubExecutor:
+        has_ongoing_execution = False
+
+    def __init__(self):
+        self._executor = self._StubExecutor()
+
+
+def test_selfhealing_breaker_opens_degrades_and_recovers():
+    clock = FakeClock()
+    notifier = SelfHealingNotifier(breaker_threshold=2, breaker_cooldown_s=60.0,
+                                   breaker_clock=clock)
+    det = AnomalyDetector(
+        _StubFacade(), notifier=notifier,
+        goal_violation_detector=_StubDetector(),
+        broker_failure_detector=_StubDetector(),
+        metric_anomaly_detector=_StubDetector(),
+        clock=clock,
+    )
+    controller = {"failing": True, "attempts": 0}
+
+    def handle():
+        det._queue.put(_FlakyFixAnomaly(controller))
+        return det.handle_once()
+
+    fails_before = meter_count("AnomalyDetector.fix-failures")
+    assert handle() == "FIX"
+    assert handle() == "FIX"  # second consecutive failure trips the breaker
+    snap = det.state()["selfHealingBreakers"]["GOAL_VIOLATION"]
+    assert snap["state"] == "open"
+    assert det.state()["fixFailures"]["GOAL_VIOLATION"] == 2
+    assert meter_count("AnomalyDetector.fix-failures") == fails_before + 2
+
+    # degraded mode: would-be FIX becomes a delayed CHECK, no fix attempted
+    attempts = controller["attempts"]
+    assert handle() == "CHECK"
+    assert controller["attempts"] == attempts
+
+    # breaker state is on /metrics (0=closed 1=half-open 2=open)
+    text = REGISTRY.prometheus_text()
+    assert (
+        'cruise_control_gauge{sensor="AnomalyDetector.breaker-state",'
+        'field="GOAL_VIOLATION"} 2' in text
+    )
+
+    # cooldown elapses -> one half-open probe; success closes the breaker
+    clock.t += 61.0
+    controller["failing"] = False
+    assert handle() == "FIX"
+    assert det.state()["selfHealingBreakers"]["GOAL_VIOLATION"]["state"] == "closed"
+    assert det.state()["fixesTriggered"]["GOAL_VIOLATION"] == 1
+
+
+def test_selfhealing_breaker_reopens_on_failed_probe():
+    clock = FakeClock()
+    notifier = SelfHealingNotifier(breaker_threshold=1, breaker_cooldown_s=10.0,
+                                   breaker_clock=clock)
+    notifier.record_fix_result(AnomalyType.BROKER_FAILURE, False)
+    br = notifier.breaker(AnomalyType.BROKER_FAILURE)
+    assert br.state == CircuitBreaker.OPEN
+    clock.t += 11.0
+    assert notifier._gate_fix(AnomalyType.BROKER_FAILURE)[0].name == "FIX"
+    notifier.record_fix_result(AnomalyType.BROKER_FAILURE, False)
+    assert br.state == CircuitBreaker.OPEN
+    # while open, the degraded CHECK carries the remaining cooldown
+    decision, delay = notifier._gate_fix(AnomalyType.BROKER_FAILURE)
+    assert decision.name == "CHECK" and delay == pytest.approx(10.0)
+
+
+# -- config plumbing -----------------------------------------------------------
+
+
+def test_resilience_config_keys_parse_and_map():
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({
+        "executor.task.deadline.s": "45.0",
+        "executor.retry.attempts": "6",
+        "executor.retry.backoff.s": "0.25",
+        "executor.retry.max.backoff.s": "8.0",
+        "selfhealing.breaker.threshold": "5",
+        "selfhealing.breaker.cooldown.s": "120.0",
+    })
+    ec = ExecutorConfig.from_config(cfg)
+    assert ec.task_deadline_s == 45.0
+    assert ec.num_concurrent_partition_movements_per_broker == 10  # reference default
+    rp = RetryPolicy.from_config(cfg)
+    assert (rp.max_attempts, rp.backoff_s, rp.max_backoff_s) == (6, 0.25, 8.0)
+    # defaults parse too
+    dflt = CruiseControlConfig({})
+    assert dflt.get_double("executor.task.deadline.s") == 0.0
+    assert dflt.get_int("selfhealing.breaker.threshold") == 3
+
+
+def test_resilience_keys_reach_service_wiring(tmp_path):
+    """main --config plumbing: the deadline lands on the Executor's config
+    and the breaker knobs on the detector's SelfHealingNotifier."""
+    props = tmp_path / "cc.properties"
+    props.write_text(
+        "executor.task.deadline.s=12.5\n"
+        "selfhealing.breaker.threshold=7\n"
+        "selfhealing.breaker.cooldown.s=42.0\n"
+    )
+    from cruise_control_tpu.main import build_simulated_service
+
+    _, parts = build_simulated_service(
+        num_brokers=4, num_racks=2, num_topics=3, config_path=str(props)
+    )
+    assert parts["executor"]._config.task_deadline_s == 12.5
+    notifier = parts["detector"]._notifier
+    assert notifier.breaker_threshold == 7
+    assert notifier.breaker_cooldown_s == 42.0
+    br = notifier.breaker(AnomalyType.GOAL_VIOLATION)
+    assert br.failure_threshold == 7 and br.cooldown_s == 42.0
+
+
+def test_resilience_config_rejects_bad_values():
+    from cruise_control_tpu.config.configdef import ConfigException
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"executor.retry.attempts": "0"})
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"executor.task.deadline.s": "-1"})
+
+
+# -- FaultPlan contract --------------------------------------------------------
+
+
+def test_fault_plan_rules_consume_deterministically():
+    plan = FaultPlan([FaultRule(op="reassign", action="fail", times=2)])
+    assert plan.server_intercept({"op": "reassign"})["ok"] is False
+    assert plan.server_intercept({"op": "finished"}) is None  # op mismatch
+    assert plan.server_intercept({"op": "reassign"})["ok"] is False
+    assert plan.server_intercept({"op": "reassign"}) is None  # exhausted
+    assert [f["action"] for f in plan.fired] == ["fail", "fail"]
+
+
+def test_fault_plan_client_drop_and_partition_match():
+    plan = FaultPlan([
+        FaultRule(op="reassign", action="never_finish", partition=3, times=-1),
+        FaultRule(op="*", action="drop", times=1),
+    ])
+    assert not plan.never_finishes({"op": "reassign", "partition": 1})
+    assert plan.never_finishes({"op": "reassign", "partition": 3})
+    assert plan.never_finishes({"op": "reassign", "partition": 3})  # times=-1
+    with pytest.raises(ConnectionError):
+        plan.client_intercept({"op": "ping"})
+    plan.client_intercept({"op": "ping"})  # drop exhausted -> pass through

@@ -1,0 +1,69 @@
+"""Static resilience guards over the execution path (tier-1, compile-free).
+
+Two classes of latent hang/swallow bugs are cheap to ban mechanically in
+`executor/` and `detector/` (the subsystems whose loops run unattended in
+production):
+
+  * bare `except:` — swallows KeyboardInterrupt/SystemExit and hides the
+    error class the retry layer needs for its retryable classification;
+  * `while True:` with no reachable `break`/`return` — an unbounded loop
+    with no deadline or poll cap (every poll loop must bound itself; the
+    resilience contract in docs/RESILIENCE.md depends on it).
+"""
+
+import ast
+import pathlib
+
+PKG = pathlib.Path(__file__).resolve().parents[1] / "cruise_control_tpu"
+GUARDED_DIRS = [PKG / "executor", PKG / "detector"]
+
+
+def _sources():
+    for d in GUARDED_DIRS:
+        for path in sorted(d.glob("*.py")):
+            yield path, ast.parse(path.read_text(), filename=str(path))
+
+
+def _has_escape(loop: ast.While) -> bool:
+    """A break/return lexically inside the loop body that can exit THIS loop
+    (not one bound to a nested loop or belonging to a nested function)."""
+
+    def walk(nodes, inside_nested_loop):
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # its returns/breaks don't exit our loop
+            if isinstance(node, ast.Return):
+                return True
+            if isinstance(node, ast.Break) and not inside_nested_loop:
+                return True
+            nested = inside_nested_loop or isinstance(node, (ast.While, ast.For))
+            if walk(ast.iter_child_nodes(node), nested):
+                return True
+        return False
+
+    return walk(loop.body, False)
+
+
+def test_no_bare_except_in_execution_path():
+    offenders = []
+    for path, tree in _sources():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, f"bare `except:` in guarded code: {offenders}"
+
+
+def test_no_unbounded_while_true_in_execution_path():
+    offenders = []
+    for path, tree in _sources():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.While):
+                continue
+            test = node.test
+            is_true = isinstance(test, ast.Constant) and test.value is True
+            if is_true and not _has_escape(node):
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, (
+        f"`while True` without break/return (deadline or poll cap required): "
+        f"{offenders}"
+    )

@@ -1332,6 +1332,13 @@ class OptimizerResult:
     #: compiled program is shaped for (None when the optimizer returned
     #: before preparing a context)
     bucketed: Optional[Dict] = None
+    #: drift-safety stamps (executor/validation.py), set by the facade at
+    #: model-build time: the monitor generation the model was built under and
+    #: the topology fingerprint (broker set + alive mask + per-topic
+    #: partition counts); the executor revalidates against them before and
+    #: during dispatch. None when the result was computed on a caller model.
+    generation: Optional[int] = None
+    fingerprint: Optional[object] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -1343,7 +1350,16 @@ class OptimizerResult:
 
     def summary(self) -> Dict:
         """Movement + stats summary (OptimizerResult.getProposalSummary analog)."""
+        stamp = None
+        if self.generation is not None or self.fingerprint is not None:
+            stamp = {
+                "generation": self.generation,
+                "fingerprint": (
+                    self.fingerprint.to_dict() if self.fingerprint is not None else None
+                ),
+            }
         return {
+            **({"proposalStamp": stamp} if stamp else {}),
             "numReplicaMovements": self.num_replica_moves,
             "numLeaderMovements": self.num_leadership_moves,
             "dataToMoveMB": round(self.data_to_move_mb, 3),

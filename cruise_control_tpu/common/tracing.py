@@ -25,6 +25,9 @@ Span kinds used across the pipeline (see docs/OBSERVABILITY.md):
   executor   execution lifecycle + per-phase/batch spans
   detector   anomaly-detector sweeps
   facade     get_proposals (cache hit/miss)
+  validation proposal admission + batch-boundary revalidation
+             (executor/validation.py; trimmed/admitted counts as attrs)
+  drift      proposal-batch aborts on generation skew (recompute handoff)
 
 Correlation with JAX xplane captures: the optimizer wraps its device
 dispatches in jax.profiler.TraceAnnotation("cc:...") and traces goal

@@ -368,6 +368,16 @@ def _config_def() -> ConfigDef:
     d.define("selfhealing.breaker.cooldown.s", Type.DOUBLE, 300.0, at_least(0.0), Importance.MEDIUM,
              "Seconds an open self-healing breaker waits before admitting one "
              "half-open probe fix (success closes it, failure re-opens).")
+    d.define("executor.proposal.revalidate", Type.BOOLEAN, True, None, Importance.MEDIUM,
+             "Revalidate generation-stamped proposals against fresh metadata at "
+             "admission and before every dispatch batch; stale proposals are trimmed "
+             "with per-proposal reason codes (DEST_DEAD, REPLICA_MOVED, TOPIC_GONE, ...) "
+             "into the execution summary instead of being dispatched or raising.")
+    d.define("executor.proposal.max.generation.skew", Type.INT, 8, at_least(0), Importance.MEDIUM,
+             "Abort the whole proposal batch (through the never-raise contract) and "
+             "notify the anomaly detector to recompute when the monitor generation "
+             "has moved more than this past the batch's model-build stamp. "
+             "0 disables the abort (per-proposal trimming still applies).")
     # --- observability (TPU-native keys; docs/OBSERVABILITY.md)
     d.define("observability.trace.ring.size", Type.INT, 4096, at_least(16), Importance.LOW,
              "Completed tracer spans retained in memory (the /trace window); "

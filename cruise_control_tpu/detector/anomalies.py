@@ -85,6 +85,35 @@ class BrokerFailures(Anomaly):
 
 
 @dataclasses.dataclass
+class ProposalDriftAnomaly(Anomaly):
+    """The executor aborted a proposal batch because the cluster drifted too
+    far from the batch's model (generation skew past
+    `executor.proposal.max.generation.skew`, docs/RESILIENCE.md). The stale
+    plan is gone; the fix is a fresh one — ride the goal-violation
+    self-healing path (same cache-bypassing rebalance a violated goal
+    triggers), so breakers, enables, and the busy-executor gate all apply."""
+
+    drift: Dict
+    anomaly_type = AnomalyType.GOAL_VIOLATION
+
+    def fix(self, facade):
+        from cruise_control_tpu.analyzer.context import OptimizationOptions
+
+        return facade.rebalance(
+            dryrun=False,
+            options=OptimizationOptions(is_triggered_by_goal_violation=True),
+            ignore_proposal_cache=True,
+        )
+
+    def describe(self) -> Dict:
+        return {
+            "anomalyType": self.anomaly_type.name,
+            "kind": "PROPOSAL_DRIFT",
+            "drift": dict(self.drift),
+        }
+
+
+@dataclasses.dataclass
 class MetricAnomaly(Anomaly):
     """One broker metric out of its historical band. Fix is a no-op, matching
     KafkaMetricAnomaly's TODO fix (cc/detector/KafkaMetricAnomaly.java)."""

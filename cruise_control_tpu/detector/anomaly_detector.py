@@ -58,9 +58,32 @@ class AnomalyDetector:
         self._fixes: Dict[str, int] = {t.name: 0 for t in AnomalyType}
         self._fix_failures: Dict[str, int] = {t.name: 0 for t in AnomalyType}
         self._recent: List[Dict] = []
+        self._drift_notifications = 0
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._register_breaker_gauge()
+        # executor → detector drift channel: a batch aborted for generation
+        # skew queues a recompute through the normal self-healing path
+        set_listener = getattr(facade._executor, "set_drift_listener", None)
+        if set_listener is not None:
+            set_listener(self.on_proposal_drift)
+
+    def on_proposal_drift(self, info: Dict) -> None:
+        """Executor drift-abort callback: queue a ProposalDriftAnomaly so the
+        recompute rides the anomaly handler (notifier gating, breakers, and
+        the busy-executor delayed-CHECK all apply)."""
+        from cruise_control_tpu.common.oplog import op_log
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.detector.anomalies import ProposalDriftAnomaly
+
+        REGISTRY.meter("AnomalyDetector.proposal-drift-notifications").mark()
+        self._drift_notifications += 1
+        anomaly = ProposalDriftAnomaly(drift=dict(info))
+        self._counts[anomaly.anomaly_type.name] += 1
+        self._recent.append(anomaly.describe())
+        self._recent = self._recent[-50:]
+        self._queue.put(anomaly)
+        op_log("Proposal drift notification queued for recompute: %s", info)
 
     def _register_breaker_gauge(self) -> None:
         """Expose breaker states on /metrics (0=closed, 1=half-open, 2=open);
@@ -205,6 +228,7 @@ class AnomalyDetector:
             "fixFailures": dict(self._fix_failures),
             "recentAnomalies": list(self._recent),
             "queuedAnomalies": self._queue.qsize(),
+            "proposalDriftNotifications": self._drift_notifications,
         }
         breakers = getattr(self._notifier, "breakers_state", None)
         if breakers is not None:

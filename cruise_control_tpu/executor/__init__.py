@@ -22,6 +22,12 @@ from cruise_control_tpu.executor.tracker import ExecutionTaskTracker
 from cruise_control_tpu.executor.driver import ClusterDriver, SimulatorClusterDriver
 from cruise_control_tpu.executor.executor import Executor, ExecutorConfig, ExecutorState
 from cruise_control_tpu.executor.tcp_driver import TcpClusterDriver
+from cruise_control_tpu.executor.validation import (
+    TopologyFingerprint,
+    TopologyView,
+    validate_proposal,
+    validate_proposals,
+)
 
 __all__ = [
     "BaseReplicaMovementStrategy",
@@ -41,4 +47,8 @@ __all__ = [
     "TaskState",
     "TaskType",
     "TcpClusterDriver",
+    "TopologyFingerprint",
+    "TopologyView",
+    "validate_proposal",
+    "validate_proposals",
 ]

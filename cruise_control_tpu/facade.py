@@ -122,6 +122,27 @@ class CruiseControl:
         )
 
     @staticmethod
+    def _stamp_result(result: OptimizerResult, generation: int, topo) -> OptimizerResult:
+        """Drift-safety stamps (executor/validation.py): the monitor
+        generation and the topology fingerprint at model-build time ride the
+        result so the executor can revalidate the batch against fresh
+        metadata before (and while) dispatching."""
+        from cruise_control_tpu.executor.validation import TopologyFingerprint
+
+        result.generation = generation
+        result.fingerprint = TopologyFingerprint.from_topology(topo)
+        return result
+
+    def _execute_result(self, result: OptimizerResult, **kwargs) -> Dict:
+        """Dispatch an optimizer result with its drift stamps attached."""
+        return self._executor.execute_proposals(
+            result.proposals,
+            generation=result.generation,
+            fingerprint=result.fingerprint,
+            **kwargs,
+        )
+
+    @staticmethod
     def _attach_topic_names(result: OptimizerResult, meta) -> OptimizerResult:
         """Fill each proposal's topicPartition from the model metadata: the
         reference's proposals are topic-partition keyed (ExecutionProposal),
@@ -193,6 +214,7 @@ class CruiseControl:
             with self._monitor.acquire_for_model_generation():
                 generation = self._monitor.generation
                 model, _meta = self._monitor.cluster_model(req)
+                _topo = self._monitor._metadata.refresh_metadata()
             from cruise_control_tpu.analyzer.context import resolve_options
 
             options = resolve_options(options, model, _meta.topic_names)
@@ -206,6 +228,7 @@ class CruiseControl:
         )
         if generation >= 0:
             result = self._attach_topic_names(result, _meta)
+            result = self._stamp_result(result, generation, _topo)
         if use_cache and generation >= 0:
             with self._cache_lock:
                 self._cached = _CachedProposals(result, generation, self._clock(), req)
@@ -227,7 +250,7 @@ class CruiseControl:
         self._sanity_check_dry_run(dryrun)
         result = self.get_proposals(goal_names, requirements, options, ignore_proposal_cache)
         if not dryrun:
-            self._executor.execute_proposals(result.proposals)
+            self._execute_result(result)
         return result
 
     def decommission_brokers(
@@ -245,9 +268,11 @@ class CruiseControl:
         self.sanity_check_hard_goal_presence(goal_names, skip_hard_goal_check)
         self._sanity_check_dry_run(dryrun)
         with self._monitor.acquire_for_model_generation():
+            generation = self._monitor.generation
             model, _meta = self._monitor.cluster_model(
                 self._config.default_requirements
             )
+            _topo = self._monitor._metadata.refresh_metadata()
         state = np.array(model.broker_state)
         state[list(broker_indices)] = BrokerState.DEAD
         model = model._replace(broker_state=state)
@@ -257,8 +282,9 @@ class CruiseControl:
             options=resolve_options(options, model, _meta.topic_names),
         )
         result = self._attach_topic_names(result, _meta)
+        result = self._stamp_result(result, generation, _topo)
         if not dryrun:
-            self._executor.execute_proposals(result.proposals, removed_brokers=broker_indices)
+            self._execute_result(result, removed_brokers=broker_indices)
         return result
 
     def add_brokers(
@@ -272,7 +298,9 @@ class CruiseControl:
         self.sanity_check_hard_goal_presence(goal_names, skip_hard_goal_check)
         self._sanity_check_dry_run(dryrun)
         with self._monitor.acquire_for_model_generation():
+            generation = self._monitor.generation
             model, _meta = self._monitor.cluster_model(self._config.default_requirements)
+            _topo = self._monitor._metadata.refresh_metadata()
         state = np.array(model.broker_state)
         state[list(broker_indices)] = BrokerState.NEW
         model = model._replace(broker_state=state)
@@ -280,8 +308,9 @@ class CruiseControl:
             model, goal_names=self._effective_goals(goal_names)
         )
         result = self._attach_topic_names(result, _meta)
+        result = self._stamp_result(result, generation, _topo)
         if not dryrun:
-            self._executor.execute_proposals(result.proposals)
+            self._execute_result(result)
         return result
 
     def demote_brokers(self, broker_indices: Set[int], dryrun: bool = True) -> OptimizerResult:
@@ -291,7 +320,9 @@ class CruiseControl:
         leadership."""
         self._sanity_check_dry_run(dryrun)
         with self._monitor.acquire_for_model_generation():
+            generation = self._monitor.generation
             model, _meta = self._monitor.cluster_model(self._config.default_requirements)
+            _topo = self._monitor._metadata.refresh_metadata()
         state = np.array(model.broker_state)
         state[list(broker_indices)] = BrokerState.DEMOTED
         model = model._replace(broker_state=state)
@@ -303,8 +334,9 @@ class CruiseControl:
             options=OptimizationOptions(excluded_brokers_for_leadership=mask),
         )
         result = self._attach_topic_names(result, _meta)
+        result = self._stamp_result(result, generation, _topo)
         if not dryrun:
-            self._executor.execute_proposals(result.proposals, demoted_brokers=broker_indices)
+            self._execute_result(result, demoted_brokers=broker_indices)
         return result
 
     def update_topic_replication_factor(
@@ -323,7 +355,9 @@ class CruiseControl:
             raise IllegalRequestException("replication_factor must be >= 1")
         self._sanity_check_dry_run(dryrun)
         with self._monitor.acquire_for_model_generation():
+            generation = self._monitor.generation
             model, meta = self._monitor.cluster_model(self._config.default_requirements)
+            _topo = self._monitor._metadata.refresh_metadata()
         pattern = _re.compile(topic_pattern)
         topic_ids = {
             t for t, name in enumerate(meta.topic_names) if pattern.fullmatch(name)
@@ -366,7 +400,13 @@ class CruiseControl:
                     )
                 )
         if not dryrun and proposals:
-            self._executor.execute_proposals(proposals)
+            from cruise_control_tpu.executor.validation import TopologyFingerprint
+
+            self._executor.execute_proposals(
+                proposals,
+                generation=generation,
+                fingerprint=TopologyFingerprint.from_topology(_topo),
+            )
         return {
             "topics": sorted(meta.topic_names[t] for t in topic_ids),
             "replicationFactor": replication_factor,

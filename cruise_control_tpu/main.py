@@ -39,8 +39,9 @@ def build_simulated_service(
     `observability.*` keys configure the span tracer (ring size, JSONL sink)
     and arm the one-shot profiler capture (docs/OBSERVABILITY.md), and the
     resilience keys (`executor.task.deadline.s`, `executor.retry.*`,
-    `selfhealing.breaker.*`) shape the executor deadline/retry behavior and
-    the self-healing circuit breakers (docs/RESILIENCE.md)."""
+    `executor.proposal.revalidate`, `executor.proposal.max.generation.skew`,
+    `selfhealing.breaker.*`) shape the executor deadline/retry/drift-safety
+    behavior and the self-healing circuit breakers (docs/RESILIENCE.md)."""
     from cruise_control_tpu.analyzer.optimizer import GoalOptimizer
     from cruise_control_tpu.async_ops import AsyncCruiseControl
     from cruise_control_tpu.detector import AnomalyDetector, SelfHealingNotifier

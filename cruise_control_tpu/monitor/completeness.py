@@ -9,6 +9,28 @@ from __future__ import annotations
 import dataclasses
 
 
+class ModelCompletenessError(ValueError):
+    """The monitor's windows cannot satisfy the requested completeness.
+
+    A ValueError subclass so existing handlers keep working; the REST layer
+    maps it to a typed 503 (`errorClass` + `completeness` detail) instead of
+    a generic 500 — "not enough data yet" is a retryable service condition,
+    not an internal failure. `completeness` carries the observed-vs-required
+    numbers for the caller's backoff decision."""
+
+    def __init__(self, message: str, completeness: dict):
+        super().__init__(message)
+        self.completeness = dict(completeness)
+
+
+class NotEnoughValidWindowsError(ModelCompletenessError):
+    """Fewer valid aggregation windows than min_required_num_windows."""
+
+
+class NotEnoughValidPartitionsError(ModelCompletenessError):
+    """Monitored-partition ratio below min_monitored_partitions_percentage."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelCompletenessRequirements:
     min_required_num_windows: int = 1

@@ -32,7 +32,11 @@ from cruise_control_tpu.monitor.aggregator import (
     Extrapolation,
     WindowedAggregator,
 )
-from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessRequirements,
+    NotEnoughValidPartitionsError,
+    NotEnoughValidWindowsError,
+)
 from cruise_control_tpu.monitor.metadata import (
     BrokerCapacityConfigResolver,
     MetadataClient,
@@ -416,22 +420,41 @@ class LoadMonitor:
         topo = self._metadata.refresh_metadata()
         self._ensure_universe(topo)
 
-        agg = self._partition_agg.aggregate(
-            options=AggregationOptions(
-                min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
-                min_valid_windows=requirements.min_required_num_windows,
+        try:
+            agg = self._partition_agg.aggregate(
+                options=AggregationOptions(
+                    min_valid_entity_ratio=requirements.min_monitored_partitions_percentage,
+                    min_valid_windows=requirements.min_required_num_windows,
+                )
             )
-        )
+        except ValueError as e:
+            # a cold aggregator ("no samples added yet" / "no completed
+            # windows yet") is a completeness condition, not an internal
+            # error — surface it typed so the REST tier answers 503
+            raise NotEnoughValidWindowsError(str(e), {
+                "validPartitionRatio": 0.0,
+                "requiredPartitionRatio": requirements.min_monitored_partitions_percentage,
+                "validWindows": 0,
+                "requiredWindows": requirements.min_required_num_windows,
+            }) from e
         c = agg.completeness
+        completeness = {
+            "validPartitionRatio": round(float(c.valid_entity_ratio), 4),
+            "requiredPartitionRatio": requirements.min_monitored_partitions_percentage,
+            "validWindows": len(c.valid_windows),
+            "requiredWindows": requirements.min_required_num_windows,
+        }
         if c.valid_entity_ratio < requirements.min_monitored_partitions_percentage:
-            raise ValueError(
+            raise NotEnoughValidPartitionsError(
                 f"not enough valid partitions: {c.valid_entity_ratio:.3f} < "
-                f"{requirements.min_monitored_partitions_percentage:.3f}"
+                f"{requirements.min_monitored_partitions_percentage:.3f}",
+                completeness,
             )
         if len(c.valid_windows) < requirements.min_required_num_windows:
-            raise ValueError(
+            raise NotEnoughValidWindowsError(
                 f"not enough valid windows: {len(c.valid_windows)} < "
-                f"{requirements.min_required_num_windows}"
+                f"{requirements.min_required_num_windows}",
+                completeness,
             )
 
         values = agg.values  # f32[P, W, M_common]

@@ -131,6 +131,22 @@ class CruiseControlApp:
             dumps=lambda o: json.dumps(o, default=str),
         )
 
+    @staticmethod
+    def _completeness_payload(exc: BaseException) -> Optional[Dict]:
+        """Typed JSON body for model-completeness failures
+        (monitor/completeness.py): the error class plus the
+        observed-vs-required numbers, so clients can back off instead of
+        treating "not enough windows yet" as a server bug."""
+        from cruise_control_tpu.monitor.completeness import ModelCompletenessError
+
+        if not isinstance(exc, ModelCompletenessError):
+            return None
+        return {
+            "errorMessage": str(exc),
+            "errorClass": type(exc).__name__,
+            "completeness": exc.completeness,
+        }
+
     async def _async_op(self, request, endpoint: str, factory) -> web.Response:
         """Run/attach a long op; 200 + result when done within the wait
         budget, else 202 + progress with the User-Task-ID header."""
@@ -154,6 +170,9 @@ class CruiseControlApp:
             )
         exc = future.exception()
         if exc is not None:
+            payload = self._completeness_payload(exc)
+            if payload is not None:  # typed 503: retryable "not enough data"
+                return self._json(payload, status=503, headers=headers)
             status = 400 if isinstance(exc, IllegalRequestException) else 500
             return self._json({"errorMessage": str(exc)}, status=status, headers=headers)
         payload = await asyncio.to_thread(self._render_result, future.result())
@@ -256,7 +275,9 @@ class CruiseControlApp:
             # at scale and must not stall concurrent requests
             payload = await asyncio.to_thread(build)
         except ValueError as e:
-            return self._json({"errorMessage": str(e)}, status=503)
+            return self._json(
+                self._completeness_payload(e) or {"errorMessage": str(e)}, status=503
+            )
         return self._json(payload)
 
     async def partition_load(self, request) -> web.Response:
@@ -301,7 +322,9 @@ class CruiseControlApp:
             # requests (same hazard as /load above)
             payload = await asyncio.to_thread(build)
         except ValueError as e:
-            return self._json({"errorMessage": str(e)}, status=503)
+            return self._json(
+                self._completeness_payload(e) or {"errorMessage": str(e)}, status=503
+            )
         return self._json(payload)
 
     async def proposals(self, request) -> web.Response:

@@ -17,6 +17,12 @@ integration test in tests/test_resilience.py is driven through this plan —
 the retry, deadline, and breaker behaviors are exercised against the real
 socket protocol, not mocks.
 
+Protocol faults compose with CLUSTER faults: testing/chaos.py streams
+seeded topology perturbations (broker death, topic delete, partition-count
+change, load spikes) into the simulator while the executor is mid-batch —
+a FaultPlan drives the wire, a ChaosPlan drives the cluster
+(tests/test_chaos_replay.py runs both at once).
+
 Actions:
   fail          answer {"ok": false, "error": ...} without dispatching
   drop          sever the connection without answering (DropConnection

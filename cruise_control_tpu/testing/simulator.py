@@ -215,6 +215,91 @@ class SimulatedCluster:
         with self._lock:
             self._state[broker_index] = BrokerState.ALIVE
 
+    # -- topology perturbations (chaos replay, testing/chaos.py) ---------------
+
+    def delete_topic(self, topic: int) -> int:
+        """Drop every partition of the topic (the mid-batch topic-delete
+        drift case): all partition-axis arrays shrink and the dense indices
+        of later partitions SHIFT — exactly the hazard the executor's
+        revalidation must catch. Returns the number of partitions removed."""
+        from cruise_control_tpu.models.flat_model import ClusterMetadata
+
+        with self._lock:
+            keep = self._topic_id != int(topic)
+            removed = int((~keep).sum())
+            if removed == 0:
+                return 0
+            self._assignment = self._assignment[keep]
+            self._part_load = self._part_load[keep]
+            self._topic_id = self._topic_id[keep]
+            self._meta = ClusterMetadata(
+                topic_names=self._meta.topic_names,
+                partition_index=np.asarray(self._meta.partition_index)[keep],
+                broker_ids=np.asarray(self._meta.broker_ids),
+                rack_names=self._meta.rack_names,
+                host_names=self._meta.host_names,
+                topic_of_partition=self._topic_id.copy(),
+            )
+            return removed
+
+    def add_partitions(self, topic: int, count: int) -> int:
+        """Grow a topic by `count` partitions (the partition-count-change
+        drift case): new rows append with replicas round-robined over alive
+        brokers and zero load. Returns the new partition count of the topic."""
+        from cruise_control_tpu.models.flat_model import ClusterMetadata
+
+        with self._lock:
+            mask = self._topic_id == int(topic)
+            if mask.any():  # new partitions inherit the topic's RF
+                rf = int((self._assignment[mask] >= 0).sum(axis=1).max())
+            else:
+                rf = min(2, int(self._state.shape[0]))
+            rf = max(1, rf)
+            alive = [int(b) for b in range(self._state.shape[0])
+                     if self._state[b] != BrokerState.DEAD]
+            if not alive:
+                return 0
+            pidx = np.asarray(self._meta.partition_index)
+            existing = pidx[self._topic_id == int(topic)]
+            next_index = int(existing.max()) + 1 if existing.size else 0
+            rows = []
+            for i in range(count):
+                replicas = [alive[(next_index + i + j) % len(alive)]
+                            for j in range(min(rf, len(alive)))]
+                row = np.full(self._assignment.shape[1], -1, dtype=np.int32)
+                row[: len(replicas)] = replicas
+                rows.append(row)
+            self._assignment = np.concatenate([self._assignment, np.stack(rows)])
+            self._part_load = np.concatenate([
+                self._part_load,
+                np.zeros((count, self._part_load.shape[1]), dtype=np.float32),
+            ])
+            self._topic_id = np.concatenate([
+                self._topic_id, np.full(count, int(topic), dtype=np.int32)
+            ])
+            self._meta = ClusterMetadata(
+                topic_names=self._meta.topic_names,
+                partition_index=np.concatenate([
+                    pidx, np.arange(next_index, next_index + count, dtype=np.int32)
+                ]),
+                broker_ids=np.asarray(self._meta.broker_ids),
+                rack_names=self._meta.rack_names,
+                host_names=self._meta.host_names,
+                topic_of_partition=self._topic_id.copy(),
+            )
+            return int((self._topic_id == int(topic)).sum())
+
+    def spike_load(self, topic: int, factor: float) -> None:
+        """Multiply the topic's partition load (hot-load spike): no topology
+        change, so the metadata generation must NOT bump — load drift is the
+        optimizer's business, not admission's."""
+        with self._lock:
+            self._part_load[self._topic_id == int(topic)] *= np.float32(factor)
+
+    def replication_factor_of(self, partition: int) -> int:
+        with self._lock:
+            return int((self._assignment[partition] >= 0).sum())
+
     def has_partition(self, partition: int, broker_index: int) -> bool:
         with self._lock:
             return bool((self._assignment[partition] == broker_index).any())

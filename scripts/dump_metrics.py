@@ -13,7 +13,9 @@ Output (stdout):
   2. the slowest recent spans with their attributes (engine, rounds, goal),
   3. sensor histograms/timers from /metrics, ranked by total seconds,
   4. the resilience picture: self-healing circuit-breaker states and the
-     retry/dead-task/dispatch-failure counters (docs/RESILIENCE.md).
+     retry/dead-task/dispatch-failure counters (docs/RESILIENCE.md),
+  5. the proposal drift/validation picture: trimmed-by-reason counts, the
+     generation-skew gauge, and the batch-abort counter.
 
 --raw additionally prints the raw Prometheus exposition text.
 """
@@ -148,6 +150,42 @@ def _resilience_section(text: str) -> None:
             print(f"   {sensor:<52} {count:>8}")
 
 
+def _drift_section(text: str) -> None:
+    """Proposal drift/validation picture (docs/RESILIENCE.md): trimmed-by-
+    reason counts, the generation-skew gauge, batch aborts, and revalidation
+    failures — rendered next to the PR-4 resilience section."""
+    skew = None
+    trimmed = {}
+    counters = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        labels = _parse_labels(labels_raw)
+        sensor = labels.get("sensor", "")
+        if name == "cruise_control_gauge" and sensor == "Executor.generation-skew":
+            skew = int(float(value))
+        elif name == "cruise_control_meter_total":
+            if sensor.startswith("Executor.proposal-trimmed."):
+                trimmed[sensor.rsplit(".", 1)[1]] = int(float(value))
+            elif sensor in ("Executor.proposal-trimmed", "Executor.batch-aborts",
+                            "Executor.revalidation-failures"):
+                counters[sensor] = int(float(value))
+    print("\n== proposal drift / validation ==")
+    if skew is None and not trimmed and not counters:
+        print("   (no drift sensors exported — executor has not validated a batch)")
+        return
+    if skew is not None:
+        print(f"   generation skew (last observed)                      {skew:>8}")
+    for sensor, count in sorted(counters.items(), key=lambda kv: -kv[1]):
+        marker = "!!" if count and sensor == "Executor.batch-aborts" else "  "
+        print(f"{marker} {sensor:<52} {count:>8}")
+    for reason, count in sorted(trimmed.items(), key=lambda kv: -kv[1]):
+        if count:
+            print(f"   trimmed[{reason}]".ljust(55) + f"{count:>8}")
+
+
 def _sensor_table(text: str) -> None:
     latencies = _parse_prometheus_latencies(text)
     print("\n== sensors (ranked by total seconds) ==")
@@ -178,6 +216,7 @@ def main() -> int:
     _slow_spans(trace.get("spans", []))
     _sensor_table(metrics_text)
     _resilience_section(metrics_text)
+    _drift_section(metrics_text)
     print(f"\ntracer overhead: {trace.get('overheadS', 0.0):.6f}s")
     if args.raw:
         print("\n== raw /metrics ==")

@@ -1,0 +1,242 @@
+"""Chaos replay suite (testing/chaos.py, ISSUE acceptance).
+
+Each scenario streams seeded perturbations into the simulated cluster WHILE
+the executor is mid-batch (per-broker concurrency 1 + multi-poll movement
+latency force many batch boundaries) and asserts the drift-safety
+invariants:
+
+  * zero invariant violations — no dispatch to a dead/invalid broker, no
+    dispatch referencing a vanished partition/replica;
+  * replication factor preserved end-to-end for every surviving partition;
+  * every task terminal (never-raise contract), stale proposals trimmed
+    into the summary with per-proposal reason codes instead of raising;
+  * the executor returns to NO_TASK_IN_PROGRESS.
+
+All host-side and compile-free: proposals are hand-diffed against the
+simulator, never optimizer output."""
+
+import pytest
+
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.executor import validation as V
+from cruise_control_tpu.executor.executor import ExecutorConfig
+from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+from cruise_control_tpu.testing.chaos import ChaosHarness, ChaosPlan, Perturbation
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+
+def make_sim(seed=7):
+    return SimulatedCluster(random_cluster(
+        seed, ClusterProperty(num_racks=3, num_brokers=8, num_topics=6,
+                              replication_factor=2)
+    ))
+
+
+def run_scenario(plan, seed=11, count=40, sim_seed=7, config=None):
+    h = ChaosHarness(make_sim(sim_seed), plan, config=config)
+    summary = h.execute(h.stamped_proposals(seed=seed, count=count))
+    return h, summary
+
+
+def assert_invariants(h, summary):
+    assert h.checker.violations == []
+    by = summary["byState"]
+    assert by["PENDING"] == by["IN_PROGRESS"] == by["ABORTING"] == 0
+    assert h.executor.state == "NO_TASK_IN_PROGRESS"
+    v = summary["proposalValidation"]
+    for t in v["trimmed"]:
+        assert t["reason"] in V.REASON_CODES
+    assert sum(v["trimmedByReason"].values()) == v["numTrimmed"]
+    return v
+
+
+#: the seeded scenario matrix — ≥8 distinct perturbation shapes; every entry
+#: runs mid-batch against a fresh cluster (names double as documentation)
+SCENARIOS = {
+    "broker_death": ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=3),
+    ]),
+    "broker_death_then_revival": ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=3),
+        Perturbation(at_poll=8, action="restore_broker", broker=3),
+    ]),
+    "double_broker_death": ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=1),
+        Perturbation(at_poll=5, action="kill_broker", broker=6),
+    ]),
+    "topic_delete": ChaosPlan([
+        Perturbation(at_poll=3, action="delete_topic", topic=2),
+    ]),
+    "partition_count_change": ChaosPlan([
+        Perturbation(at_poll=3, action="add_partitions", topic=1, count=4),
+    ]),
+    "hot_load_spike": ChaosPlan([
+        Perturbation(at_poll=2, action="spike_load", topic=0, factor=16.0),
+        Perturbation(at_poll=5, action="spike_load", topic=3, factor=16.0),
+    ]),
+    "death_plus_topic_delete": ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=3),
+        Perturbation(at_poll=6, action="delete_topic", topic=1),
+    ]),
+    "combined_everything": ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=3),
+        Perturbation(at_poll=4, action="delete_topic", topic=4),
+        Perturbation(at_poll=7, action="add_partitions", topic=2, count=2),
+        Perturbation(at_poll=9, action="spike_load", topic=0, factor=8.0),
+    ]),
+    "early_death_mass_trim": ChaosPlan([
+        Perturbation(at_poll=1, action="kill_broker", broker=0),
+        Perturbation(at_poll=1, action="kill_broker", broker=4),
+    ]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_chaos_scenario_holds_invariants(name):
+    # plans are stateful; build a fresh copy per run
+    plan = ChaosPlan([Perturbation(**{k: v for k, v in p.items()
+                                      if k != "firedAtPoll"})
+                      for p in _plan_spec(SCENARIOS[name])])
+    h, summary = run_scenario(plan, seed=11 + len(name))
+    v = assert_invariants(h, summary)
+    assert plan.exhausted, "every scheduled perturbation fired mid-run"
+    assert not v["aborted"]
+
+
+def _plan_spec(plan):
+    import dataclasses as dc
+
+    return [dc.asdict(p) for p in plan._pending]
+
+
+def test_broker_death_trims_dest_dead_not_raises():
+    plan = ChaosPlan([Perturbation(at_poll=2, action="kill_broker", broker=3)])
+    h, summary = run_scenario(plan, seed=13)
+    v = assert_invariants(h, summary)
+    # proposals destined for broker 3 were trimmed with the reason code
+    assert v["trimmedByReason"].get(V.DEST_DEAD, 0) >= 1
+    assert all(t["reason"] == V.DEST_DEAD for t in v["trimmed"])
+    # killed-broker destinations never received a replica after the kill
+    assert all(viol == [] for viol in [h.checker.violations])
+
+
+def test_topic_delete_trims_gone_and_remapped():
+    plan = ChaosPlan([Perturbation(at_poll=3, action="delete_topic", topic=1)])
+    h, summary = run_scenario(plan, seed=17)
+    v = assert_invariants(h, summary)
+    reasons = set(v["trimmedByReason"])
+    # the deleted topic's own proposals die TOPIC_GONE; later topics' rows
+    # shifted underneath their dense indices and die PARTITION_REMAPPED
+    assert V.TOPIC_GONE in reasons
+    assert V.PARTITION_REMAPPED in reasons
+
+
+def test_benign_perturbations_do_not_overtrim():
+    """Partition adds (appended rows) and load spikes invalidate nothing —
+    the validator must not trim a single proposal for them."""
+    plan = ChaosPlan([
+        Perturbation(at_poll=2, action="add_partitions", topic=1, count=4),
+        Perturbation(at_poll=5, action="spike_load", topic=0, factor=32.0),
+    ])
+    h, summary = run_scenario(plan, seed=19)
+    v = assert_invariants(h, summary)
+    assert v["numTrimmed"] == 0
+    assert summary["byState"]["COMPLETED"] == summary["numTotalMovements"]
+
+
+def test_self_churn_never_trips_skew_abort():
+    """The executor's own movements bump the metadata generation; even at
+    the tightest skew setting a drift-free execution must run to completion."""
+    plan = ChaosPlan()
+    h, summary = run_scenario(
+        plan, seed=5, count=50,
+        config=ExecutorConfig(num_concurrent_partition_movements_per_broker=1,
+                              execution_progress_check_interval_s=0.002,
+                              max_generation_skew=1),
+    )
+    v = assert_invariants(h, summary)
+    assert not v["aborted"] and v["numTrimmed"] == 0
+    assert summary["byState"]["COMPLETED"] == summary["numTotalMovements"] > 0
+
+
+def test_structural_drift_past_skew_aborts_mid_batch():
+    """Widely spaced structural changes step the effective skew; past the
+    threshold the remaining batch aborts through the never-raise contract
+    and the drift notification fires."""
+    plan = ChaosPlan([
+        Perturbation(at_poll=2, action="kill_broker", broker=1),
+        Perturbation(at_poll=8, action="kill_broker", broker=2),
+        Perturbation(at_poll=14, action="kill_broker", broker=6),
+    ])
+    h = ChaosHarness(make_sim(), plan, config=ExecutorConfig(
+        num_concurrent_partition_movements_per_broker=1,
+        execution_progress_check_interval_s=0.002,
+        max_generation_skew=1,
+    ))
+    events = []
+    h.executor._notifier = lambda e, info: events.append(e)
+    drift = []
+    h.executor.set_drift_listener(drift.append)
+    aborts_before = REGISTRY.meter("Executor.batch-aborts").count
+    summary = h.execute(h.stamped_proposals(seed=29, count=60))
+    v = assert_invariants(h, summary)
+    assert v["aborted"] and "generation skew" in v["abortReason"]
+    assert v["trimmedByReason"].get(V.GENERATION_SKEW, 0) >= 1
+    assert "proposal_batch_aborted" in events
+    assert drift and drift[0]["reason"] == V.GENERATION_SKEW
+    assert REGISTRY.meter("Executor.batch-aborts").count == aborts_before + 1
+    # the batch died but nothing raised and nothing is stuck
+    assert summary["byState"]["ABORTED"] >= 1
+
+
+def test_protocol_faults_compose_with_chaos():
+    """A FaultPlan on the wire and a ChaosPlan on the cluster at the same
+    time: the resilience layer handles the dispatch failure, the drift layer
+    handles the dead broker, and the invariants still hold."""
+    from cruise_control_tpu.testing.faults import FaultPlan, FaultRule
+
+    plan = ChaosPlan([Perturbation(at_poll=3, action="kill_broker", broker=2)])
+    h = ChaosHarness(make_sim(23), plan)
+    faults = FaultPlan([FaultRule(op="*", action="fail", times=1)])
+    inner_start = h.driver.start_replica_movement
+
+    def flaky_start(task):
+        injected = faults.server_intercept({"op": "reassign",
+                                            "partition": task.proposal.partition})
+        if injected is not None:
+            raise ConnectionError(injected["error"])
+        inner_start(task)
+
+    h.driver.start_replica_movement = flaky_start
+    summary = h.execute(h.stamped_proposals(seed=31, count=30))
+    assert_invariants(h, summary)
+    assert summary["byState"]["DEAD"] == 1  # the injected dispatch failure
+    assert any("dispatch failure" in t["reason"] for t in summary["failedTasks"])
+
+
+def test_revalidation_overhead_under_2pct():
+    """The acceptance contract: with realistic (multi-poll) movement latency
+    the whole validation layer — admission + every batch boundary — costs
+    under 2% of execution wall time."""
+    plan = ChaosPlan([Perturbation(at_poll=4, action="kill_broker", broker=3)])
+    h = ChaosHarness(make_sim(), plan, latency_polls=6)
+    summary = h.execute(h.stamped_proposals(seed=37, count=40))
+    v = assert_invariants(h, summary)
+    assert v["batchRevalidations"] >= 1
+    assert v["overheadPct"] < 2.0, v
+
+
+def test_chaos_metrics_visible_on_prometheus_surface():
+    plan = ChaosPlan([Perturbation(at_poll=2, action="kill_broker", broker=3)])
+    h, summary = run_scenario(plan, seed=41)
+    assert summary["proposalValidation"]["numTrimmed"] >= 1
+    text = REGISTRY.prometheus_text()
+    assert 'sensor="Executor.proposal-trimmed"' in text
+    assert f'sensor="Executor.proposal-trimmed.{V.DEST_DEAD}"' in text
+    assert 'sensor="Executor.generation-skew"' in text
+    assert 'sensor="Executor.revalidation-timer' in text
+    # the validation spans reached the tracer (visible on /trace)
+    from cruise_control_tpu.common.tracing import TRACER
+
+    kinds = {s["kind"] for s in TRACER.recent(limit=512)}
+    assert "validation" in kinds

@@ -1,0 +1,135 @@
+"""ModelCompletenessRequirements combinator + typed-error tests.
+
+The weaker()/stronger() combinators (MonitorUtils.combineLoadRequirement-
+Options analog) had no dedicated coverage; they gate which cached proposals
+are reusable and which models are buildable, so their algebra is pinned
+here: commutativity, idempotence, associativity, and the weaker∘stronger
+bounds. The typed completeness errors (ModelCompletenessError tree) are the
+REST tier's 503 contract."""
+
+import itertools
+
+import pytest
+
+from cruise_control_tpu.monitor.completeness import (
+    ModelCompletenessError,
+    ModelCompletenessRequirements,
+    NotEnoughValidPartitionsError,
+    NotEnoughValidWindowsError,
+)
+
+R = ModelCompletenessRequirements
+
+SAMPLES = [
+    R(1, 0.5, False),
+    R(3, 0.995, True),
+    R(8, 0.2, False),
+    R(1, 1.0, True),
+    R(5, 0.5, True),
+]
+
+
+@pytest.mark.parametrize("a,b", list(itertools.combinations(SAMPLES, 2)))
+def test_combinators_commute(a, b):
+    assert a.weaker(b) == b.weaker(a)
+    assert a.stronger(b) == b.stronger(a)
+
+
+@pytest.mark.parametrize("r", SAMPLES)
+def test_combinators_idempotent(r):
+    assert r.weaker(r) == r
+    assert r.stronger(r) == r
+
+
+@pytest.mark.parametrize("a,b,c", list(itertools.combinations(SAMPLES, 3)))
+def test_combinators_associative(a, b, c):
+    assert a.weaker(b).weaker(c) == a.weaker(b.weaker(c))
+    assert a.stronger(b).stronger(c) == a.stronger(b.stronger(c))
+
+
+def _leq(x: R, y: R) -> bool:
+    """x is no more demanding than y on every axis."""
+    return (
+        x.min_required_num_windows <= y.min_required_num_windows
+        and x.min_monitored_partitions_percentage
+        <= y.min_monitored_partitions_percentage
+        and (not x.include_all_topics or y.include_all_topics)
+    )
+
+
+@pytest.mark.parametrize("a,b", list(itertools.combinations(SAMPLES, 2)))
+def test_weaker_stronger_bound_both_operands(a, b):
+    """weaker(a,b) ≤ {a, b} ≤ stronger(a,b) on every axis, and the two
+    compose to the lattice absorption laws."""
+    w, s = a.weaker(b), a.stronger(b)
+    assert _leq(w, a) and _leq(w, b)
+    assert _leq(a, s) and _leq(b, s)
+    assert _leq(w, s)
+    # absorption: combining a with a bound of (a, b) gives a back
+    assert a.weaker(s) == a
+    assert a.stronger(w) == a
+
+
+def test_weaker_stronger_field_semantics():
+    a, b = R(3, 0.9, True), R(5, 0.5, False)
+    w, s = a.weaker(b), a.stronger(b)
+    assert (w.min_required_num_windows, s.min_required_num_windows) == (3, 5)
+    assert (w.min_monitored_partitions_percentage,
+            s.min_monitored_partitions_percentage) == (0.5, 0.9)
+    assert (w.include_all_topics, s.include_all_topics) == (False, True)
+
+
+# -- typed completeness errors -------------------------------------------------
+
+
+def test_error_types_are_valueerrors_with_detail():
+    e = NotEnoughValidWindowsError("nope", {"validWindows": 1, "requiredWindows": 5})
+    assert isinstance(e, ValueError) and isinstance(e, ModelCompletenessError)
+    assert e.completeness["requiredWindows"] == 5
+    assert issubclass(NotEnoughValidPartitionsError, ModelCompletenessError)
+
+
+def test_monitor_raises_typed_completeness_errors():
+    """A live monitor short on windows raises the typed error, carrying the
+    observed-vs-required numbers the REST 503 surfaces."""
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+    from cruise_control_tpu.reporter.transport import InMemoryTransport
+    from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+    sim = SimulatedCluster(random_cluster(
+        3, ClusterProperty(num_racks=2, num_brokers=4, num_topics=3,
+                           replication_factor=2)
+    ))
+    transport = InMemoryTransport()
+    clock = {"now": 0.0}
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3,
+                                 min_samples_per_window=1),
+        clock=lambda: clock["now"],
+    )
+    monitor.start_up()
+    # a cold monitor (no windows at all) is a windows-completeness failure
+    with pytest.raises(NotEnoughValidWindowsError) as ei:
+        monitor.cluster_model(R(1, 0.0, False))
+    assert ei.value.completeness["validWindows"] == 0
+
+    for r in range(3):
+        transport.publish(sim.all_metrics(r * 1000 + 500))
+        clock["now"] = r + 0.8
+        monitor.sample_once()
+    # windows exist but fewer than demanded
+    with pytest.raises(NotEnoughValidWindowsError) as ei:
+        monitor.cluster_model(R(99, 0.0, False))
+    assert ei.value.completeness["requiredWindows"] == 99
+    assert ei.value.completeness["validWindows"] < 99
+    # and an impossible partition ratio is the partitions variant
+    with pytest.raises(NotEnoughValidPartitionsError):
+        monitor.cluster_model(R(1, 1.1, False))
+    # sane requirements still build the model
+    model, _meta = monitor.cluster_model(R(1, 0.5, False))
+    assert model.num_brokers == 4

@@ -1,0 +1,450 @@
+"""Proposal drift safety tests (executor/validation.py, docs/RESILIENCE.md).
+
+Unit tier: TopologyFingerprint semantics and every validator reason code.
+Integration tier (compile-free, host-side): admission trimming through a
+real Executor, the generation-skew abort through the never-raise contract,
+the executor → detector recompute handoff, facade stamping with a stub
+optimizer, and the PR-4-style config plumbing for the `executor.proposal.*`
+keys."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.executor import (
+    Executor,
+    ExecutorConfig,
+    SimulatorClusterDriver,
+    TaskState,
+    TopologyFingerprint,
+    TopologyView,
+    validate_proposal,
+    validate_proposals,
+)
+from cruise_control_tpu.executor import validation as V
+from cruise_control_tpu.models.generators import (
+    ClusterProperty,
+    random_cluster,
+    unbalanced,
+)
+from cruise_control_tpu.monitor.metadata import MetadataClient
+from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+
+def proposal(p, old, new, tp=None):
+    return ExecutionProposal(partition=p, old_replicas=old, new_replicas=new,
+                             topic_partition=tp)
+
+
+def small_sim(seed=7):
+    return SimulatedCluster(random_cluster(
+        seed, ClusterProperty(num_racks=3, num_brokers=6, num_topics=4,
+                              replication_factor=2)
+    ))
+
+
+# -- TopologyFingerprint -------------------------------------------------------
+
+
+def test_fingerprint_stability_and_digest():
+    sim = small_sim()
+    a = TopologyFingerprint.from_topology(sim.fetch_topology())
+    b = TopologyFingerprint.from_topology(sim.fetch_topology())
+    assert a == b and a.digest == b.digest
+    assert a.num_brokers == 6 and a.num_alive == 6
+    assert a.num_partitions == sum(c for _, c in a.topic_partitions)
+
+
+def test_fingerprint_detects_structural_drift_not_load():
+    sim = small_sim()
+    base = TopologyFingerprint.from_topology(sim.fetch_topology())
+
+    sim.spike_load(0, 8.0)  # load is NOT structure
+    assert TopologyFingerprint.from_topology(sim.fetch_topology()) == base
+
+    sim.kill_broker(2)
+    dead = TopologyFingerprint.from_topology(sim.fetch_topology())
+    assert dead != base and dead.digest != base.digest
+    assert base.diff(dead)["brokersDied"] == [2]
+    sim.restore_broker(2)
+
+    sim.delete_topic(1)
+    gone = TopologyFingerprint.from_topology(sim.fetch_topology())
+    assert base.diff(gone)["topicsGone"] == ["topic-1"]
+
+    sim.add_partitions(0, 2)
+    grown = TopologyFingerprint.from_topology(sim.fetch_topology())
+    assert "topic-0" in gone.diff(grown)["partitionCountChanged"]
+
+
+# -- per-proposal validator: every reason code ---------------------------------
+
+
+def _view(sim):
+    return TopologyView(sim.fetch_topology())
+
+
+def _movement_for(sim, row):
+    """A valid movement proposal for `row` against current state."""
+    view = _view(sim)
+    old = view.replicas(row)
+    dst = next(b for b in range(view.num_brokers)
+               if b not in old and not view.broker_dead(b))
+    new = (dst,) + tuple(old[1:])
+    return proposal(row, old, new, tp=view.name_of(row))
+
+
+def test_validator_accepts_fresh_proposal():
+    sim = small_sim()
+    assert validate_proposal(_movement_for(sim, 0), _view(sim)) is None
+
+
+def test_validator_dest_dead_and_invalid():
+    sim = small_sim()
+    p = _movement_for(sim, 0)
+    sim.kill_broker(p.replicas_to_add[0])
+    assert validate_proposal(p, _view(sim)) == V.DEST_DEAD
+    bad = dataclasses.replace(p, new_replicas=(99,) + p.new_replicas[1:])
+    assert validate_proposal(bad, _view(sim)) == V.DEST_INVALID
+
+
+def test_validator_topic_gone_and_remapped():
+    sim = small_sim()
+    view = _view(sim)
+    row_t1 = next(r for _, r in view.items() if view.name_of(r).startswith("topic-1-"))
+    gone = _movement_for(sim, row_t1)
+    # a later topic's partition: its dense row shifts when topic 1 vanishes
+    row_t3 = next(r for _, r in view.items() if view.name_of(r).startswith("topic-3-"))
+    shifted = _movement_for(sim, row_t3)
+    sim.delete_topic(1)
+    fresh = _view(sim)
+    assert validate_proposal(gone, fresh) == V.TOPIC_GONE
+    assert validate_proposal(shifted, fresh) == V.PARTITION_REMAPPED
+
+
+def test_validator_partition_gone():
+    sim = small_sim()
+    view = _view(sim)
+    p = _movement_for(sim, view.num_partitions - 1)
+    # name a partition index that never existed
+    missing = dataclasses.replace(
+        p, topic_partition=p.topic_partition.rsplit("-", 1)[0] + "-9999"
+    )
+    assert validate_proposal(missing, view) == V.PARTITION_GONE
+
+
+def test_validator_replica_moved_and_rf_changed():
+    sim = small_sim()
+    p = _movement_for(sim, 0)
+    src = p.replicas_to_remove[0]
+    other = next(b for b in range(6) if not sim.has_partition(0, b)
+                 and b != p.replicas_to_add[0])
+    sim.apply_movement(0, src, other)  # a concurrent reassignment won
+    assert validate_proposal(p, _view(sim)) == V.REPLICA_MOVED
+
+    sim2 = small_sim()
+    p2 = _movement_for(sim2, 0)
+    view2 = _view(sim2)
+    free = next(b for b in range(6) if b not in view2.replicas(0))
+    sim2.add_replica(0, free)  # RF grew underneath the plan
+    assert validate_proposal(p2, _view(sim2)) == V.RF_CHANGED
+
+
+def test_validator_leadership_proposals():
+    sim = small_sim()
+    view = _view(sim)
+    old = view.replicas(0)
+    assert len(old) >= 2
+    lead = proposal(0, old, (old[1], old[0]) + tuple(old[2:]),
+                    tp=view.name_of(0))
+    assert not lead.has_replica_action and lead.has_leader_action
+    assert validate_proposal(lead, view) is None
+    sim.kill_broker(old[1])
+    assert validate_proposal(lead, _view(sim)) == V.DEST_DEAD
+
+
+def test_validate_proposals_splits_valid_and_trimmed():
+    sim = small_sim()
+    good = _movement_for(sim, 0)
+    bad = _movement_for(sim, 1)
+    sim.kill_broker(bad.replicas_to_add[0])
+    if good.replicas_to_add[0] == bad.replicas_to_add[0]:
+        good = _movement_for(sim, 0)  # re-pick against post-kill state
+    valid, trimmed = validate_proposals([good, bad], sim.fetch_topology())
+    assert valid == [good]
+    assert trimmed == [(bad, V.DEST_DEAD)]
+
+
+# -- executor integration ------------------------------------------------------
+
+
+def _executor_over(sim, **config):
+    mc = MetadataClient(sim.fetch_topology, ttl_s=0.0)
+    gen = {"extra": 0}
+    execu = Executor(
+        SimulatorClusterDriver(sim, latency_polls=1),
+        config=ExecutorConfig(execution_progress_check_interval_s=0.002,
+                              **config),
+        topology_source=lambda: mc.refresh_metadata(force=True),
+        generation_source=lambda: mc.generation + gen["extra"],
+    )
+    return execu, mc, gen
+
+
+def test_admission_trims_stale_proposals_and_executes_rest():
+    sim = small_sim()
+    execu, mc, _ = _executor_over(sim)
+    good = _movement_for(sim, 0)
+    stale = _movement_for(sim, 1)
+    stamp_gen = mc.generation
+    fp = TopologyFingerprint.from_topology(mc.refresh_metadata(force=True))
+    sim.kill_broker(stale.replicas_to_add[0])  # drift between build and execute
+    if good.replicas_to_add[0] == stale.replicas_to_add[0]:
+        pytest.skip("seed picked the same destination twice")
+    trims_before = REGISTRY.meter(f"Executor.proposal-trimmed.{V.DEST_DEAD}").count
+    summary = execu.execute_proposals([good, stale], generation=stamp_gen,
+                                      fingerprint=fp)
+    v = summary["proposalValidation"]
+    assert v["enabled"] and not v["aborted"]
+    assert v["admitted"] == 1 and v["numTrimmed"] == 1
+    (t,) = v["trimmed"]
+    assert t["reason"] == V.DEST_DEAD and t["phase"] == "admission"
+    assert t["topicPartition"] == stale.topic_partition
+    assert v["trimmedByReason"] == {V.DEST_DEAD: 1}
+    assert v["fingerprintDrift"]["brokersDied"] == [stale.replicas_to_add[0]]
+    assert summary["byState"][TaskState.COMPLETED.name] == 1
+    assert REGISTRY.meter(f"Executor.proposal-trimmed.{V.DEST_DEAD}").count \
+        == trims_before + 1
+    # the trimmed proposal's movement never reached the cluster
+    assert not sim.has_partition(stale.partition, stale.replicas_to_add[0])
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+
+
+def test_generation_skew_abort_never_raises_and_notifies():
+    sim = small_sim()
+    execu, mc, gen = _executor_over(sim, max_generation_skew=2)
+    events = []
+    drift_infos = []
+    execu._notifier = lambda e, info: events.append(e)
+    execu.set_drift_listener(drift_infos.append)
+    stamp_gen = mc.generation
+    gen["extra"] = 5  # the monitor raced 5 generations ahead of the stamp
+    aborts_before = REGISTRY.meter("Executor.batch-aborts").count
+    summary = execu.execute_proposals(
+        [_movement_for(sim, 0), _movement_for(sim, 1)],
+        generation=stamp_gen,
+        fingerprint=TopologyFingerprint.from_topology(sim.fetch_topology()),
+    )
+    v = summary["proposalValidation"]
+    assert v["aborted"] and "generation skew" in v["abortReason"]
+    assert v["generationSkew"] == 5 and v["admitted"] == 0
+    assert v["trimmedByReason"] == {V.GENERATION_SKEW: 2}
+    assert summary["byState"][TaskState.COMPLETED.name] == 0
+    assert summary["numTotalMovements"] == 0  # nothing was ever registered
+    assert "proposal_batch_aborted" in events
+    assert drift_infos and drift_infos[0]["reason"] == V.GENERATION_SKEW
+    assert drift_infos[0]["generationSkew"] == 5
+    assert REGISTRY.meter("Executor.batch-aborts").count == aborts_before + 1
+    assert execu.state == "NO_TASK_IN_PROGRESS"
+    # /state carries the record
+    assert execu.state_summary()["proposalValidation"]["aborted"] is True
+
+
+def test_revalidation_disabled_passes_everything():
+    sim = small_sim()
+    execu, mc, gen = _executor_over(sim, proposal_revalidate=False,
+                                    max_generation_skew=1)
+    gen["extra"] = 50
+    stale = _movement_for(sim, 0)
+    sim.kill_broker(stale.replicas_to_add[0])
+    summary = execu.execute_proposals(
+        [stale], generation=mc.generation - 50,
+        fingerprint=TopologyFingerprint.from_topology(sim.fetch_topology()),
+    )
+    v = summary["proposalValidation"]
+    assert v["enabled"] is False and not v["aborted"] and v["numTrimmed"] == 0
+    # without validation the stale task is dispatched and the driver applies
+    # it blindly — the exact hazard the layer exists to remove
+    assert summary["byState"][TaskState.COMPLETED.name] == 1
+
+
+def test_unstamped_batches_still_validate_topologically():
+    """PR-4 call sites that pass bare proposals (no stamps) keep working, and
+    still get per-proposal topology checks when a source exists."""
+    sim = small_sim()
+    execu, _, _ = _executor_over(sim)
+    stale = _movement_for(sim, 0)
+    sim.kill_broker(stale.replicas_to_add[0])
+    summary = execu.execute_proposals([stale])
+    v = summary["proposalValidation"]
+    assert v["generationAtBuild"] is None and v["generationSkew"] is None
+    assert v["trimmedByReason"] == {V.DEST_DEAD: 1}
+    assert summary["byState"][TaskState.COMPLETED.name] == 0
+
+
+def test_executor_without_topology_source_is_unchanged():
+    """The PR-4 resilience tests construct Executors with no monitor and no
+    topology source — validation must be a no-op there."""
+    sim = SimulatedCluster(unbalanced())
+    execu = Executor(SimulatorClusterDriver(sim))
+    summary = execu.execute_proposals(
+        [ExecutionProposal(partition=0, old_replicas=(0, 1), new_replicas=(2, 1))]
+    )
+    assert summary["byState"][TaskState.COMPLETED.name] == 1
+    assert summary["proposalValidation"]["numTrimmed"] == 0
+
+
+# -- executor -> detector recompute handoff ------------------------------------
+
+
+class _StubDetector:
+    def detect(self):
+        return None
+
+
+def test_drift_abort_queues_detector_recompute():
+    from cruise_control_tpu.detector.anomalies import ProposalDriftAnomaly
+    from cruise_control_tpu.detector.anomaly_detector import AnomalyDetector
+    from cruise_control_tpu.detector.notifier import SelfHealingNotifier
+
+    sim = small_sim()
+    execu, mc, gen = _executor_over(sim, max_generation_skew=1)
+
+    class _Facade:
+        def __init__(self):
+            self._executor = execu
+            self.rebalances = []
+
+        def rebalance(self, **kwargs):
+            self.rebalances.append(kwargs)
+            return "recomputed"
+
+    facade = _Facade()
+    det = AnomalyDetector(
+        facade, notifier=SelfHealingNotifier(),
+        goal_violation_detector=_StubDetector(),
+        broker_failure_detector=_StubDetector(),
+        metric_anomaly_detector=_StubDetector(),
+    )
+    gen["extra"] = 10
+    execu.execute_proposals([_movement_for(sim, 0)], generation=mc.generation)
+    assert det.state()["proposalDriftNotifications"] == 1
+    queued = det._queue.queue[0]
+    assert isinstance(queued, ProposalDriftAnomaly)
+    assert queued.describe()["kind"] == "PROPOSAL_DRIFT"
+    # the handler runs the fix through the normal self-healing path
+    assert det.handle_once() == "FIX"
+    (kwargs,) = facade.rebalances
+    assert kwargs["dryrun"] is False and kwargs["ignore_proposal_cache"] is True
+    assert kwargs["options"].is_triggered_by_goal_violation
+
+
+# -- facade stamping (stub optimizer, compile-free) ----------------------------
+
+
+def test_facade_stamps_and_hands_stamps_to_executor(monkeypatch):
+    import cruise_control_tpu.analyzer.optimizer as opt
+    from cruise_control_tpu.analyzer.optimizer import OptimizerResult
+
+    # the stub result carries no cluster stats; summary() only needs them
+    # for the balancedness block, which this test does not exercise
+    monkeypatch.setattr(opt, "stats_to_dict", lambda s: {})
+    from cruise_control_tpu.facade import CruiseControl, FacadeConfig
+    from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+    from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+    from cruise_control_tpu.reporter.transport import InMemoryTransport
+
+    sim = small_sim()
+    transport = InMemoryTransport()
+    clock = {"now": 0.0}
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(transport),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3,
+                                 min_samples_per_window=1),
+        clock=lambda: clock["now"],
+    )
+    monitor.start_up()
+    for r in range(4):
+        transport.publish(sim.all_metrics(r * 1000 + 500))
+        clock["now"] = r + 0.8
+        monitor.sample_once()
+
+    class _StubOptimizer:
+        def optimizations(self, model, **kwargs):
+            view = TopologyView(sim.fetch_topology())
+            old = view.replicas(0)
+            dst = next(b for b in range(view.num_brokers) if b not in old)
+            return OptimizerResult(
+                proposals=[ExecutionProposal(
+                    partition=0, old_replicas=old,
+                    new_replicas=(dst,) + tuple(old[1:]),
+                )],
+                goal_results=[], stats_before=None, stats_after=None,
+                final_assignment=np.asarray(model.assignment),
+                num_replica_moves=1, num_leadership_moves=0,
+                data_to_move_mb=0.0, duration_s=0.0,
+            )
+
+    executor = Executor(SimulatorClusterDriver(sim, latency_polls=1),
+                        config=ExecutorConfig(
+                            execution_progress_check_interval_s=0.002),
+                        load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor, optimizer=_StubOptimizer(),
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    result = facade.rebalance(dryrun=False, skip_hard_goal_check=True)
+    assert result.generation is not None and result.generation >= 0
+    assert isinstance(result.fingerprint, TopologyFingerprint)
+    assert result.summary()["proposalStamp"]["generation"] == result.generation
+    v = executor.state_summary()["proposalValidation"]
+    assert v["generationAtBuild"] == result.generation
+    assert v["fingerprintAtBuild"]["digest"] == result.fingerprint.digest
+    assert v["admitted"] == 1 and not v["aborted"]
+
+
+# -- config plumbing (PR-4 pattern) --------------------------------------------
+
+
+def test_proposal_config_keys_parse_and_map():
+    from cruise_control_tpu.config.configdef import ConfigException
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({
+        "executor.proposal.revalidate": "false",
+        "executor.proposal.max.generation.skew": "17",
+    })
+    ec = ExecutorConfig.from_config(cfg)
+    assert ec.proposal_revalidate is False
+    assert ec.max_generation_skew == 17
+    dflt = CruiseControlConfig({})
+    assert dflt.get_boolean("executor.proposal.revalidate") is True
+    assert dflt.get_int("executor.proposal.max.generation.skew") == 8
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"executor.proposal.max.generation.skew": "-1"})
+
+
+def test_proposal_keys_reach_service_wiring(tmp_path):
+    """main --config plumbing, matching the PR-4 resilience pattern."""
+    props = tmp_path / "cc.properties"
+    props.write_text(
+        "executor.proposal.revalidate=true\n"
+        "executor.proposal.max.generation.skew=3\n"
+    )
+    from cruise_control_tpu.main import build_simulated_service
+
+    _, parts = build_simulated_service(
+        num_brokers=4, num_racks=2, num_topics=3, config_path=str(props)
+    )
+    assert parts["executor"]._config.proposal_revalidate is True
+    assert parts["executor"]._config.max_generation_skew == 3
+    # the detector wired itself as the executor's drift listener
+    assert parts["executor"]._drift_listener is not None

@@ -388,3 +388,66 @@ def test_static_webui_serving(tmp_path):
     finally:
         loop.call_soon_threadsafe(loop.stop)
         th.join(timeout=5)
+
+
+def test_model_completeness_failure_is_typed_503_over_live_server():
+    """Regression: a monitor short on windows must answer a typed 503 with a
+    `completeness` detail block (NotEnoughValidWindowsError), never a
+    generic 500 — on both the async-op path (/proposals) and the direct
+    model-build path (/load)."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from cruise_control_tpu.models.generators import random_cluster as _rc
+
+    truth = _rc(5, ClusterProperty(num_racks=2, num_brokers=4, num_topics=3,
+                                   replication_factor=2))
+    sim = SimulatedCluster(truth)
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(InMemoryTransport()),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=2,
+                                 min_samples_per_window=1),
+    )
+    monitor.start_up()  # cold: no samples, no windows
+    executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor,
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    acc = AsyncCruiseControl(facade)
+    app = CruiseControlApp(acc, response_wait_s=2.0)
+    port = _free_port()
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    base = f"http://127.0.0.1:{port}/kafkacruisecontrol"
+    try:
+        for endpoint in ("proposals", "load"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/{endpoint}")
+            assert ei.value.code == 503, endpoint
+            body = json.loads(ei.value.read().decode())
+            assert body["errorClass"] == "NotEnoughValidWindowsError", endpoint
+            assert body["completeness"]["validWindows"] == 0
+            assert body["completeness"]["requiredWindows"] >= 0
+            assert "errorMessage" in body
+    finally:
+        loop.call_soon_threadsafe(loop.stop)
+        th.join(timeout=5)
+        acc.shutdown()

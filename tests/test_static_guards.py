@@ -1,8 +1,10 @@
 """Static resilience guards over the execution path (tier-1, compile-free).
 
 Two classes of latent hang/swallow bugs are cheap to ban mechanically in
-`executor/` and `detector/` (the subsystems whose loops run unattended in
-production):
+`executor/`, `detector/`, `monitor/`, and `servlet/` (the subsystems whose
+loops run unattended in production — the monitor's sampling/aggregation
+loops and the servlet's request handlers joined the guarded set with the
+drift-validation layer, which leans on all four):
 
   * bare `except:` — swallows KeyboardInterrupt/SystemExit and hides the
     error class the retry layer needs for its retryable classification;
@@ -15,7 +17,7 @@ import ast
 import pathlib
 
 PKG = pathlib.Path(__file__).resolve().parents[1] / "cruise_control_tpu"
-GUARDED_DIRS = [PKG / "executor", PKG / "detector"]
+GUARDED_DIRS = [PKG / "executor", PKG / "detector", PKG / "monitor", PKG / "servlet"]
 
 
 def _sources():

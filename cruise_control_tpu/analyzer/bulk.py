@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Bulk count-rebalance planner: the surplus/deficit wave kernel for
 count-distribution goals.
 

@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Goal registry: name -> singleton goal instance, in reference priority order.
 
 Mirrors the default goal stack of cc/config/KafkaCruiseControlConfig.java:1287-1322

@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Goal SPI: each goal is a set of pure vectorized functions.
 
 The counterpart of the reference Goal interface (cc/analyzer/goals/Goal.java:38)

@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Hard goals: rack awareness, replica capacity, resource capacity.
 
 Kernels mirroring the semantics of:

@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Kafka-assigner mode goals.
 
 Drop-in replacements for the legacy kafka-assigner tool, selected when a

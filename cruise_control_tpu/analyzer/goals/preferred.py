@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """PreferredLeaderElectionGoal: leadership back to the preferred replica.
 
 The reference utility goal (cc/analyzer/goals/PreferredLeaderElectionGoal.java:33)

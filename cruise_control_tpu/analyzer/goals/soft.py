@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """Soft goals: distribution balancing and potential-load guards.
 
 Kernels with the semantics of:

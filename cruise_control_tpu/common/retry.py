@@ -160,13 +160,13 @@ class CircuitBreaker:
         self.cooldown_s = cooldown_s
         self._clock = clock
         self._lock = threading.Lock()
-        self._state = self.CLOSED
-        self._consecutive_failures = 0
-        self._opened_at: Optional[float] = None
-        self._probe_in_flight = False
-        self._opens = 0
+        self._state = self.CLOSED  #: guarded_by(_lock)
+        self._consecutive_failures = 0  #: guarded_by(_lock)
+        self._opened_at: Optional[float] = None  #: guarded_by(_lock)
+        self._probe_in_flight = False  #: guarded_by(_lock)
+        self._opens = 0  #: guarded_by(_lock)
 
-    def _record_transition(self, target: str) -> None:
+    def _record_transition_locked(self, target: str) -> None:
         from cruise_control_tpu.common.sensors import REGISTRY
         from cruise_control_tpu.common.tracing import TRACER
 
@@ -195,7 +195,7 @@ class CircuitBreaker:
                 if self._clock() - self._opened_at >= self.cooldown_s:
                     self._state = self.HALF_OPEN
                     self._probe_in_flight = True
-                    self._record_transition(self.HALF_OPEN)
+                    self._record_transition_locked(self.HALF_OPEN)
                     return True
                 return False
             # HALF_OPEN: one probe at a time
@@ -211,7 +211,7 @@ class CircuitBreaker:
             if self._state != self.CLOSED:
                 self._state = self.CLOSED
                 self._opened_at = None
-                self._record_transition(self.CLOSED)
+                self._record_transition_locked(self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -227,7 +227,7 @@ class CircuitBreaker:
                 self._opened_at = self._clock()
                 if not already_open:
                     self._opens += 1
-                    self._record_transition(self.OPEN)
+                    self._record_transition_locked(self.OPEN)
 
     def remaining_cooldown_s(self) -> float:
         with self._lock:

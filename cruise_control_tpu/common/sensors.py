@@ -27,10 +27,10 @@ class Timer:
     """Count + total/max/last seconds; use as a context manager."""
 
     def __init__(self) -> None:
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-        self.last_s = 0.0
+        self.count = 0  #: guarded_by(_lock)
+        self.total_s = 0.0  #: guarded_by(_lock)
+        self.max_s = 0.0  #: guarded_by(_lock)
+        self.last_s = 0.0  #: guarded_by(_lock)
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
@@ -63,7 +63,7 @@ class Meter:
     """Monotonic event counter."""
 
     def __init__(self) -> None:
-        self.count = 0
+        self.count = 0  #: guarded_by(_lock)
         self._lock = threading.Lock()
 
     def mark(self, n: int = 1) -> None:
@@ -95,11 +95,11 @@ class Histogram:
         self.bounds: Tuple[float, ...] = tuple(sorted(float(b) for b in bounds))
         if not self.bounds:
             raise ValueError("histogram needs at least one bucket bound")
-        self._counts = [0] * (len(self.bounds) + 1)  # last = overflow (+inf)
-        self.count = 0
-        self.total_s = 0.0
-        self.max_s = 0.0
-        self.last_s = 0.0
+        self._counts = [0] * (len(self.bounds) + 1)  #: guarded_by(_lock) — last = overflow (+inf)
+        self.count = 0  #: guarded_by(_lock)
+        self.total_s = 0.0  #: guarded_by(_lock)
+        self.max_s = 0.0  #: guarded_by(_lock)
+        self.last_s = 0.0  #: guarded_by(_lock)
         self._lock = threading.Lock()
 
     def record(self, seconds: float) -> None:
@@ -172,10 +172,10 @@ class Histogram:
 class SensorRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._timers: Dict[str, Timer] = {}
-        self._meters: Dict[str, Meter] = {}
-        self._hists: Dict[str, Histogram] = {}
-        self._gauges: Dict[str, Callable[[], object]] = {}
+        self._timers: Dict[str, Timer] = {}  #: guarded_by(_lock)
+        self._meters: Dict[str, Meter] = {}  #: guarded_by(_lock)
+        self._hists: Dict[str, Histogram] = {}  #: guarded_by(_lock)
+        self._gauges: Dict[str, Callable[[], object]] = {}  #: guarded_by(_lock)
 
     def timer(self, name: str) -> Timer:
         with self._lock:

@@ -90,12 +90,12 @@ class Tracer:
 
     def __init__(self, ring_size: int = 4096, jsonl_path: Optional[str] = None):
         self._lock = threading.Lock()
-        self._ring: "collections.deque[Span]" = collections.deque(maxlen=ring_size)
+        self._ring: "collections.deque[Span]" = collections.deque(maxlen=ring_size)  #: guarded_by(_lock)
         self._local = threading.local()
-        self._jsonl_path = jsonl_path
-        self._jsonl_file = None
-        self._overhead_s = 0.0
-        self._completed = 0
+        self._jsonl_path = jsonl_path  #: guarded_by(_lock)
+        self._jsonl_file = None  #: guarded_by(_lock)
+        self._overhead_s = 0.0  #: guarded_by(_lock)
+        self._completed = 0  #: guarded_by(_lock)
 
     # -- configuration ---------------------------------------------------------
 
@@ -117,7 +117,10 @@ class Tracer:
 
     @property
     def ring_size(self) -> int:
-        return self._ring.maxlen or 0
+        # under the lock: `configure` swaps the ring object out from other
+        # threads (the /state gauge reads this concurrently)
+        with self._lock:
+            return self._ring.maxlen or 0
 
     @property
     def overhead_s(self) -> float:

@@ -19,6 +19,7 @@ from being re-fired forever while keeping the anomaly on the queue."""
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
 
@@ -68,8 +69,12 @@ class SelfHealingNotifier(AnomalyNotifier):
     breaker_cooldown_s: float = 300.0
     #: injectable monotonic clock (deterministic breaker tests)
     breaker_clock: Callable[[], float] = time.monotonic
+    #: guarded_by(_lock)
     _breakers: Dict[str, CircuitBreaker] = dataclasses.field(
         default_factory=dict, init=False, repr=False, compare=False
+    )
+    _lock: threading.Lock = dataclasses.field(
+        default_factory=threading.Lock, init=False, repr=False, compare=False
     )
 
     def _alert(self, payload: Dict) -> None:
@@ -79,15 +84,19 @@ class SelfHealingNotifier(AnomalyNotifier):
     # -- per-type circuit breakers ---------------------------------------------
 
     def breaker(self, anomaly_type: AnomalyType) -> CircuitBreaker:
+        # get-or-create under the lock: the anomaly handler and the /state
+        # server thread race here, and a duplicate breaker would silently
+        # split the consecutive-failure count across two instances
         name = anomaly_type.name
-        br = self._breakers.get(name)
-        if br is None:
-            br = self._breakers[name] = CircuitBreaker(
-                f"SelfHealing.{name}",
-                failure_threshold=self.breaker_threshold,
-                cooldown_s=self.breaker_cooldown_s,
-                clock=self.breaker_clock,
-            )
+        with self._lock:
+            br = self._breakers.get(name)
+            if br is None:
+                br = self._breakers[name] = CircuitBreaker(
+                    f"SelfHealing.{name}",
+                    failure_threshold=self.breaker_threshold,
+                    cooldown_s=self.breaker_cooldown_s,
+                    clock=self.breaker_clock,
+                )
         return br
 
     def record_fix_result(self, anomaly_type: AnomalyType, success: bool) -> None:

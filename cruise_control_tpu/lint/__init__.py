@@ -1,0 +1,25 @@
+"""cclint: repo-native static analysis for the TPU, concurrency, and
+registry invariants the codebase rests on (docs/LINTING.md).
+
+Three rule families over pure-AST/text analysis (no JAX import, tier-1
+cheap): `tpu` guards the shape-bucketed kernel contract, `concurrency`
+generalizes the never-raise/lock-discipline contracts package-wide, and
+`registry` reconciles config keys, sensor names, and span kinds against
+their declarations and documentation. CLI: `scripts/cclint.py`.
+"""
+
+from cruise_control_tpu.lint.core import (  # noqa: F401
+    EXIT_CLEAN,
+    EXIT_ERROR,
+    EXIT_FINDINGS,
+    Finding,
+    LintContext,
+    Rule,
+    RULES,
+    all_rules,
+    build_context,
+    render_human,
+    render_json,
+    run_rules,
+    unsuppressed,
+)

@@ -1,0 +1,5 @@
+"""`python -m cruise_control_tpu.lint` == `python scripts/cclint.py`."""
+
+from cruise_control_tpu.lint.cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,294 @@
+"""JAX/TPU hygiene rules (family `tpu`).
+
+These guard the shape-bucketed program-reuse contract (docs/OPTIMIZER.md):
+one compiled XLA program serves every cluster in a bucket, which only holds
+while kernels (a) never sync device buffers back to the host mid-pipeline,
+(b) never branch or loop on concrete axis sizes (each distinct size would
+retrace and recompile), (c) never read a buffer after donating it, and
+(d) never denominate a mean by a padded axis length where a valid-count
+mask exists — the exact bug class PR 3 fixed by hand five times.
+
+Scope: "kernel modules" — analyzer/goals/, analyzer/bulk.py,
+models/flat_model.py by path, plus any module carrying a
+`# cclint: kernel-module` marker (core.KERNEL_PATH_PATTERNS). The
+donated-reuse rule runs package-wide: `donate_argnums` call sites live in
+the optimizer, not the kernel modules themselves.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from cruise_control_tpu.lint.core import (
+    Finding,
+    LintContext,
+    Rule,
+    node_names,
+    register,
+)
+
+#: identifiers that name a partition/broker/topic axis extent; looping or
+#: dividing by one of these inside a kernel is a padding/recompile hazard
+AXIS_NAMES = {
+    "num_partitions", "num_brokers", "num_topics", "num_racks", "num_hosts",
+    "p_count", "b_count", "t_count", "max_rf",
+}
+
+
+@register
+class HostSyncRule(Rule):
+    id = "tpu-host-sync"
+    family = "tpu"
+    rationale = (
+        "`.item()`, `float()/int()` on arrays, `np.asarray`, and "
+        "`jax.device_get` block on the device and break async dispatch; "
+        "inside kernel modules they turn a fused pipeline into ping-pong"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for src in ctx.kernel_files:
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                if isinstance(fn, ast.Attribute):
+                    if fn.attr == "item" and not node.args and not node.keywords:
+                        yield self.finding(
+                            src, node.lineno,
+                            "`.item()` forces a device->host sync; keep the "
+                            "value on-device or move this off the kernel path",
+                        )
+                    elif (
+                        fn.attr == "asarray"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in ("np", "numpy")
+                    ):
+                        yield self.finding(
+                            src, node.lineno,
+                            "`np.asarray` on a device array copies to host; "
+                            "use `jnp.asarray` or hoist to the host-side shell",
+                        )
+                    elif (
+                        fn.attr in ("device_get", "block_until_ready")
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id == "jax"
+                    ):
+                        yield self.finding(
+                            src, node.lineno,
+                            f"`jax.{fn.attr}` synchronizes with the device; "
+                            "kernel modules must stay async",
+                        )
+                elif (
+                    isinstance(fn, ast.Name)
+                    and fn.id in ("float", "int")
+                    and node.args
+                    and not isinstance(node.args[0], (ast.Name, ast.Constant))
+                ):
+                    yield self.finding(
+                        src, node.lineno,
+                        f"`{fn.id}(...)` of a computed value syncs if it is a "
+                        "device array; use jnp casts on-device or hoist",
+                    )
+
+
+@register
+class PythonLoopRule(Rule):
+    id = "tpu-python-loop"
+    family = "tpu"
+    rationale = (
+        "a Python `for` over a partition/broker axis unrolls into the traced "
+        "program (compile blow-up) or runs one dispatch per element; use "
+        "vmap/scan/segment_sum"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for src in ctx.kernel_files:
+            for node in ast.walk(src.tree):
+                iters = []
+                if isinstance(node, ast.For):
+                    iters.append(node.iter)
+                elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                       ast.GeneratorExp)):
+                    iters.extend(g.iter for g in node.generators)
+                for it in iters:
+                    names = node_names(it)
+                    if AXIS_NAMES & names or "shape" in names:
+                        yield self.finding(
+                            src, node.lineno,
+                            "Python loop over a model axis "
+                            f"({', '.join(sorted((AXIS_NAMES & names) | ({'shape'} if 'shape' in names else set())))}); "
+                            "vectorize with vmap/scan or move off the kernel path",
+                        )
+                        break
+
+
+@register
+class ShapeBranchRule(Rule):
+    id = "tpu-shape-branch"
+    family = "tpu"
+    rationale = (
+        "branching on a concrete `.shape` retraces per shape and defeats "
+        "shape-bucketed program reuse; branch on static dims passed via "
+        "static argnums, or use jnp.where"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for src in ctx.kernel_files:
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                    if "shape" in node_names(node.test):
+                        yield self.finding(
+                            src, node.lineno,
+                            "branch tests a concrete array shape — a "
+                            "recompile per distinct shape; thread the dim "
+                            "through Dims/static argnums instead",
+                        )
+
+
+def _donated_positions(call: ast.Call):
+    """The donate_argnums of a `jax.jit`/`jit` call, or None."""
+    fn = call.func
+    is_jit = (isinstance(fn, ast.Name) and fn.id == "jit") or (
+        isinstance(fn, ast.Attribute) and fn.attr == "jit"
+    )
+    if not is_jit:
+        return None
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+            return ()  # dynamic spec: can't track positions
+    return None
+
+
+@register
+class DonatedReuseRule(Rule):
+    id = "tpu-donated-reuse"
+    family = "tpu"
+    rationale = (
+        "an argument donated via donate_argnums is dead after the call — "
+        "XLA may alias its buffer for the output; reading it afterwards is "
+        "use-after-free that only fails on real hardware"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for src in ctx.parsed_files:
+            for scope in ast.walk(src.tree):
+                if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                    yield from self._check_scope(src, scope)
+
+    def _check_scope(self, src, scope) -> Iterator[Finding]:
+        # pass 1: names bound to donating jitted callables in this scope
+        donors = {}
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                pos = _donated_positions(node.value)
+                if pos:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            donors[t.id] = pos
+        if not donors and not any(
+            _donated_positions(n) for n in ast.walk(scope) if isinstance(n, ast.Call)
+        ):
+            return
+        # pass 2: calls of donors -> donated Name args; later loads flag.
+        # Lexical (lineno) ordering — a deliberate heuristic: kernels are
+        # straight-line dispatch code, and a false negative in a loop is
+        # still caught by the fixture-tested common case.
+        donated_at = {}  # name -> call lineno
+        # same-line ordering mirrors runtime: arg loads happen before the
+        # call donates, and the assignment stores after it — so
+        # `model = step(model, n)` cleanly rebinds, not use-after-donate
+        prio = {"load": 0, "donate": 1, "store": 2}
+        events = []  # (lineno, prio, kind, name)
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Call):
+                pos = None
+                if isinstance(node.func, ast.Name) and node.func.id in donors:
+                    pos = donors[node.func.id]
+                elif isinstance(node.func, ast.Call):
+                    pos = _donated_positions(node.func)  # jit(f, donate...)(x)
+                if pos:
+                    for i in pos:
+                        if i < len(node.args) and isinstance(node.args[i], ast.Name):
+                            events.append(
+                                (node.lineno, prio["donate"], "donate", node.args[i].id)
+                            )
+            elif isinstance(node, ast.Name):
+                kind = "store" if isinstance(node.ctx, (ast.Store, ast.Del)) else "load"
+                events.append((node.lineno, prio[kind], kind, node.id))
+        events.sort(key=lambda e: (e[0], e[1]))
+        for lineno, _, kind, name in events:
+            if kind == "donate":
+                donated_at[name] = lineno
+            elif kind == "store":
+                donated_at.pop(name, None)
+            elif name in donated_at and lineno > donated_at[name]:
+                yield self.finding(
+                    src, lineno,
+                    f"`{name}` was donated to a jitted call on line "
+                    f"{donated_at[name]} and read afterwards — its buffer "
+                    "may already be aliased; rebind the result instead",
+                )
+                donated_at.pop(name, None)  # one report per donation
+
+
+@register
+class PaddingDenominatorRule(Rule):
+    id = "tpu-padding-denominator"
+    family = "tpu"
+    rationale = (
+        "dividing by a raw axis extent (num_partitions/num_brokers) makes "
+        "means drift with the shape bucket's padding; denominate by the "
+        "valid-count masks (StaticCtx.num_valid_partitions, broker_valid "
+        "sums) so bucketed runs stay result-identical"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for src in ctx.kernel_files:
+            for scope in ast.walk(src.tree):
+                if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_fn(src, scope)
+
+    def _check_fn(self, src, fn) -> Iterator[Finding]:
+        aliases = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Attribute):
+                if node.value.attr in AXIS_NAMES:
+                    aliases.update(
+                        t.id for t in node.targets if isinstance(t, ast.Name)
+                    )
+            # tuple unpack: p_count, r = dims.num_partitions, dims.max_rf
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Tuple):
+                for t in node.targets:
+                    if isinstance(t, ast.Tuple) and len(t.elts) == len(node.value.elts):
+                        for tgt, val in zip(t.elts, node.value.elts):
+                            if (
+                                isinstance(tgt, ast.Name)
+                                and isinstance(val, ast.Attribute)
+                                and val.attr in AXIS_NAMES
+                            ):
+                                aliases.add(tgt.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Div, ast.FloorDiv)):
+                d = node.right
+                hit = None
+                if isinstance(d, ast.Attribute) and d.attr in AXIS_NAMES:
+                    hit = d.attr
+                elif isinstance(d, ast.Name) and (d.id in AXIS_NAMES or d.id in aliases):
+                    hit = d.id
+                if hit is not None:
+                    yield self.finding(
+                        src, node.lineno,
+                        f"division by raw axis extent `{hit}` — under shape "
+                        "bucketing this denominator includes padding; use the "
+                        "num_valid_* masks (see soft.py LeaderBytesIn.bulk_counts)",
+                    )

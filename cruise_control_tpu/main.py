@@ -86,6 +86,7 @@ def build_simulated_service(
     optimizer = GoalOptimizer()
     executor_config = ExecutorConfig()
     notifier = SelfHealingNotifier()
+    executor_notifier = None
     if config_path:
         from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
         from cruise_control_tpu.config.balancing import BalancingConstraint
@@ -102,6 +103,13 @@ def build_simulated_service(
         # retry policy; a TcpClusterDriver deployment builds its RetryPolicy
         # from the same config (RetryPolicy.from_config).
         executor_config = ExecutorConfig.from_config(cfg)
+        # executor lifecycle events flow to the configured sink
+        # (`executor.notifier.class`; default: the operation audit log)
+        from cruise_control_tpu.executor.notifier import ExecutorNotifier
+
+        executor_notifier = cfg.get_configured_instance(
+            "executor.notifier.class", ExecutorNotifier
+        )
         notifier = SelfHealingNotifier(
             breaker_threshold=cfg.get_int("selfhealing.breaker.threshold"),
             breaker_cooldown_s=cfg.get_double("selfhealing.breaker.cooldown.s"),
@@ -116,6 +124,7 @@ def build_simulated_service(
     executor = Executor(
         SimulatorClusterDriver(sim, latency_polls=2),
         config=executor_config, load_monitor=monitor,
+        notifier=executor_notifier,
     )
     facade = CruiseControl(
         monitor, executor, optimizer=optimizer,

@@ -1,3 +1,4 @@
+# cclint: kernel-module
 """FlatClusterModel: the cluster workload model as a pytree of device arrays.
 
 This replaces the reference's mutable object graph (cc/model/ClusterModel.java:
@@ -62,6 +63,7 @@ class FlatClusterModel(NamedTuple):
     @property
     def num_topics(self) -> int:
         # static metadata: topic ids are dense [0, T)
+        # cclint: disable=tpu-host-sync,tpu-shape-branch -- host-side static metadata, read once at model-build/Dims time (never inside a traced kernel)
         return int(np.asarray(self.topic_id).max()) + 1 if self.topic_id.shape[0] else 0
 
 
@@ -84,8 +86,8 @@ class ClusterMetadata:
         """Render partition p as 'topic-partitionIndex' for proposals/REST."""
         if self.topic_of_partition is None:
             raise ValueError("ClusterMetadata built without topic_of_partition")
-        t = int(self.topic_of_partition[p])
-        return f"{self.topic_names[t]}-{int(self.partition_index[p])}"
+        t = int(self.topic_of_partition[p])  # cclint: disable=tpu-host-sync -- ClusterMetadata is host-side numpy by contract (kept out of the jitted pytree)
+        return f"{self.topic_names[t]}-{int(self.partition_index[p])}"  # cclint: disable=tpu-host-sync -- same host-side numpy metadata as the line above
 
 
 # -- basic masks ---------------------------------------------------------------
@@ -315,7 +317,7 @@ def swap_replicas(
 def sanity_check(model: FlatClusterModel) -> None:
     """Invariant checker, the analog of ClusterModel.sanityCheck
     (cc/model/ClusterModel.java:918). Host-side; raises on violation."""
-    a = np.asarray(model.assignment)
+    a = np.asarray(model.assignment)  # cclint: disable=tpu-host-sync -- sanity_check is the documented host-side invariant gate; it runs off the proposal hot path and MUST sync to raise
     b = model.num_brokers
     valid = a >= 0
     if not valid[:, 0].all():
@@ -334,8 +336,9 @@ def sanity_check(model: FlatClusterModel) -> None:
     packed = (rf == r) | (first_invalid == rf)
     if not packed.all():
         raise ValueError("replica slots must be left-packed")
-    load = np.asarray(model.part_load)
+    load = np.asarray(model.part_load)  # cclint: disable=tpu-host-sync -- host-side invariant gate (see above)
     if (load < 0).any() or not np.isfinite(load).all():
         raise ValueError("partition loads must be finite and non-negative")
+    # cclint: disable=tpu-host-sync,tpu-shape-branch -- host-side invariant gate checking static array dims (see above)
     if np.asarray(model.broker_rack).shape[0] != b or np.asarray(model.broker_host).shape[0] != b:
         raise ValueError("broker attribute arrays disagree on broker count")

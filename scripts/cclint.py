@@ -1,0 +1,21 @@
+#!/usr/bin/env python3
+"""cclint CLI wrapper: lint the package without installing it.
+
+    python scripts/cclint.py                 # full package, human output
+    python scripts/cclint.py --json          # machine output (CI)
+    python scripts/cclint.py --changed-only  # only files differing from main
+    python scripts/cclint.py --list-rules    # rule catalog
+
+Rule catalog and suppression policy: docs/LINTING.md. The same run gates
+tier-1 through tests/test_static_guards.py.
+"""
+
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from cruise_control_tpu.lint.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,5 @@
+def poll(fetch):
+    try:
+        return fetch()
+    except Exception:
+        return None

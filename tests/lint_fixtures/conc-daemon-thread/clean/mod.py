@@ -1,0 +1,14 @@
+import threading
+
+
+def start(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def start_timer(fn):
+    t = threading.Timer(5.0, fn)
+    t.daemon = True
+    t.start()
+    return t

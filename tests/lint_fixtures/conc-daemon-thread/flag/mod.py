@@ -1,0 +1,7 @@
+import threading
+
+
+def start(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return t

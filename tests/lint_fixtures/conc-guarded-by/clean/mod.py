@@ -1,0 +1,14 @@
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded_by(_lock)
+
+    def size(self):
+        with self._lock:
+            return len(self._items)
+
+    def _first_locked(self):
+        return self._items[0]

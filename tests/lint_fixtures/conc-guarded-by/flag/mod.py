@@ -1,0 +1,10 @@
+import threading
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []  #: guarded_by(_lock)
+
+    def size(self):
+        return len(self._items)

@@ -1,0 +1,13 @@
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def wait(self):
+        with self._lock:
+            self._n += 1
+        time.sleep(0.1)

@@ -1,0 +1,11 @@
+import threading
+import time
+
+
+class Poller:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def wait(self):
+        with self._lock:
+            time.sleep(0.1)

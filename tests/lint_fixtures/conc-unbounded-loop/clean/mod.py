@@ -1,0 +1,6 @@
+def spin(poll, max_polls):
+    polls = 0
+    while True:
+        if poll() or polls >= max_polls:
+            break
+        polls += 1

@@ -1,0 +1,3 @@
+def spin(poll):
+    while True:
+        poll()

@@ -1,0 +1,2 @@
+def build(d):
+    d.define("known.key", int, 1, None, None, "a declared key")

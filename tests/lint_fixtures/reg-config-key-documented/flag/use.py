@@ -1,0 +1,2 @@
+def wire(config):
+    return config.get_int("secret.key")

@@ -1,0 +1,2 @@
+def build(d):
+    d.define("optimizer.live.knob", int, 1, None, None, "read below")

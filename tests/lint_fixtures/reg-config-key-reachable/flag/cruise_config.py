@@ -1,0 +1,2 @@
+def build(d):
+    d.define("optimizer.dead.knob", int, 1, None, None, "never read")

@@ -1,0 +1,6 @@
+from cruise_control_tpu.common.sensors import REGISTRY
+
+
+def touch(tracker):
+    REGISTRY.meter("Executor.tasks-total").mark()
+    REGISTRY.gauge("Executor.tasks-active", lambda: tracker.count())

@@ -1,0 +1,6 @@
+from cruise_control_tpu.common.sensors import REGISTRY
+
+
+def touch(tracker):
+    REGISTRY.meter("Executor.tasks").mark()
+    REGISTRY.gauge("Executor.tasks", lambda: tracker.count())

@@ -1,0 +1,6 @@
+from cruise_control_tpu.common.sensors import REGISTRY
+
+
+def touch(name):
+    REGISTRY.meter("Known.sensor-total").mark()
+    REGISTRY.meter(f"Retry.{name}.retries").mark()

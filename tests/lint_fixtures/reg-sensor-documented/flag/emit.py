@@ -1,0 +1,5 @@
+from cruise_control_tpu.common.sensors import REGISTRY
+
+
+def touch():
+    REGISTRY.meter("Ghost.undocumented-total").mark()

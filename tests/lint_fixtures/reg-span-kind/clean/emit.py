@@ -1,0 +1,6 @@
+from cruise_control_tpu.common.tracing import TRACER
+
+
+def traced(fn):
+    with TRACER.span("op", kind="proposal"):
+        return fn()

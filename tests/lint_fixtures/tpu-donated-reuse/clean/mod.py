@@ -1,0 +1,8 @@
+"""Clean fixture: rebind over the donated name (the steady-state idiom)."""
+import jax
+
+
+def run(model, step_fn, rounds):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    model = step(model, rounds)
+    return model.sum()

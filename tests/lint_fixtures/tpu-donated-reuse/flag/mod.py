@@ -1,0 +1,8 @@
+"""Flagging fixture: read a buffer after donating it."""
+import jax
+
+
+def run(model, step_fn):
+    step = jax.jit(step_fn, donate_argnums=(0,))
+    out = step(model, 1)
+    return model.sum() + out

@@ -1,0 +1,6 @@
+"""Not a kernel module: host syncs are this layer's job."""
+import numpy as np
+
+
+def render(arr):
+    return float(np.asarray(arr).max())

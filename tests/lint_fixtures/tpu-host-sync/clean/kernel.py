@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Clean fixture: on-device math, plain-name casts, host code elsewhere."""
+import jax.numpy as jnp
+
+
+def good(scores, table, k):
+    width = int(k)  # plain-name cast: static python int, no sync
+    dev = jnp.asarray(table)
+    return jnp.max(scores) + dev.sum() + width

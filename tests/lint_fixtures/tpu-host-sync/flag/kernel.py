@@ -1,0 +1,12 @@
+# cclint: kernel-module
+"""Flagging fixture: host syncs inside a kernel module."""
+import jax
+import numpy as np
+
+
+def bad(scores, table):
+    best = scores.max().item()
+    host = np.asarray(table)
+    pulled = jax.device_get(scores)
+    width = int(table.sum() * 2)
+    return best, host, pulled, width

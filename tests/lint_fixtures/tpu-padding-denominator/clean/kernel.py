@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Clean fixture: valid-count denominators (padding-invariant)."""
+import jax.numpy as jnp
+
+
+def good(static, total):
+    per_part = total / jnp.maximum(1.0, static.num_valid_partitions)
+    per_broker = total / jnp.maximum(1.0, jnp.sum(static.broker_valid))
+    return per_part + per_broker

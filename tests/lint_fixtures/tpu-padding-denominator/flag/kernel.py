@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Flagging fixture: mean over the padded axis length."""
+
+
+def bad(static, total, dims):
+    b_count = dims.num_brokers
+    per_broker = total / b_count
+    per_part = total / dims.num_partitions
+    return per_broker + per_part

@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Clean fixture: loops over static config, vectorized axis math."""
+import jax.numpy as jnp
+
+
+def good(loads, goals):
+    for g in goals:  # static goal list: unrolls a fixed, tiny stack
+        loads = g.apply(loads)
+    return jnp.sum(loads)

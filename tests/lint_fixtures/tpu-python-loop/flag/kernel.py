@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Flagging fixture: python loop over a model axis."""
+
+
+def bad(loads, num_brokers):
+    total = 0.0
+    for b in range(num_brokers):
+        total += loads[b]
+    return total

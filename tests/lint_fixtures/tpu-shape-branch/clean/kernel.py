@@ -1,0 +1,8 @@
+# cclint: kernel-module
+"""Clean fixture: static dims via Dims, data branches via where."""
+import jax.numpy as jnp
+
+
+def good(x, dims, mask):
+    k = min(8, dims.num_brokers)  # static python int from Dims
+    return jnp.where(mask, x, 0.0).sum() + k

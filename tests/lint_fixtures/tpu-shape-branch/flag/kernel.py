@@ -1,0 +1,9 @@
+# cclint: kernel-module
+"""Flagging fixture: branch on a concrete array shape."""
+import jax.numpy as jnp
+
+
+def bad(x):
+    if x.shape[0] > 64:
+        return jnp.sum(x)
+    return jnp.max(x)

@@ -1,0 +1,252 @@
+"""cclint framework tests: per-rule fixtures, suppression mechanics, output
+formats, and CLI exit codes (tier-1, compile-free — pure ast/text).
+
+Every registered rule ships a minimal *flagging* fixture and a *clean*
+fixture under tests/lint_fixtures/<rule-id>/{flag,clean}/ (docs/LINTING.md
+"Adding a rule"). The driver runs the FULL rule set over each fixture
+directory and asserts only on the target rule's findings, so fixtures also
+double as integration probes for rule interaction (e.g. a suppressed
+finding marking its suppression used)."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from cruise_control_tpu.lint import (
+    EXIT_CLEAN,
+    EXIT_ERROR,
+    EXIT_FINDINGS,
+    RULES,
+    all_rules,
+    build_context,
+    render_human,
+    render_json,
+    run_rules,
+    unsuppressed,
+)
+from cruise_control_tpu.lint.cli import main as cclint_main
+
+FIXTURES = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+RULE_IDS = sorted(r.id for r in all_rules())
+
+
+def _run_fixture(rule_id: str, kind: str):
+    d = FIXTURES / rule_id / kind
+    assert d.is_dir(), (
+        f"rule {rule_id} is missing its `{kind}` fixture directory {d} — "
+        "every shipped rule needs one (docs/LINTING.md)"
+    )
+    ctx = build_context(d)
+    assert ctx.files, f"fixture {d} contains no python files"
+    return run_rules(ctx)
+
+
+class TestRuleCatalog:
+    def test_at_least_ten_rules_registered(self):
+        real = [r for r in all_rules() if r.family != "lint"]
+        assert len(real) >= 10, [r.id for r in real]
+
+    def test_three_families_shipped(self):
+        families = {r.family for r in all_rules()}
+        assert {"tpu", "concurrency", "registry"} <= families
+
+    def test_every_rule_has_id_family_rationale(self):
+        for r in all_rules():
+            assert r.id and r.family and r.rationale, r
+
+
+@pytest.mark.parametrize("rule_id", RULE_IDS)
+class TestRuleFixtures:
+    def test_flag_fixture_flags(self, rule_id):
+        findings = _run_fixture(rule_id, "flag")
+        hits = [f for f in unsuppressed(findings) if f.rule == rule_id]
+        assert hits, (
+            f"{rule_id}: flag fixture produced no finding; all findings: "
+            f"{[(f.rule, f.path, f.line) for f in findings]}"
+        )
+        for f in hits:
+            assert f.path and f.line >= 1 and f.message
+
+    def test_clean_fixture_is_clean(self, rule_id):
+        findings = _run_fixture(rule_id, "clean")
+        hits = [f for f in unsuppressed(findings) if f.rule == rule_id]
+        assert not hits, f"{rule_id}: clean fixture flagged: {hits}"
+
+
+class TestSuppressions:
+    def _ctx(self, tmp_path, body: str):
+        (tmp_path / "mod.py").write_text(body)
+        return build_context(tmp_path)
+
+    def test_same_line_suppression(self, tmp_path):
+        ctx = self._ctx(tmp_path, (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except:  # cclint: disable=conc-bare-except -- fixture\n"
+            "        return None\n"
+        ))
+        findings = run_rules(ctx, rules=[RULES["conc-bare-except"]],
+                             check_unused=False)
+        assert len(findings) == 1
+        assert findings[0].suppressed and findings[0].suppress_reason == "fixture"
+
+    def test_standalone_comment_covers_next_line(self, tmp_path):
+        ctx = self._ctx(tmp_path, (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    # cclint: disable=conc-bare-except -- fixture\n"
+            "    except:\n"
+            "        return None\n"
+        ))
+        findings = run_rules(ctx, rules=[RULES["conc-bare-except"]],
+                             check_unused=False)
+        assert [f.suppressed for f in findings] == [True]
+
+    def test_reasonless_suppression_is_malformed_and_inert(self, tmp_path):
+        ctx = self._ctx(tmp_path, (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except:  # cclint: disable=conc-bare-except\n"
+            "        return None\n"
+        ))
+        findings = run_rules(ctx, rules=[RULES["conc-bare-except"]],
+                             check_unused=False)
+        rules_seen = {f.rule for f in findings}
+        assert "lint-malformed-suppression" in rules_seen
+        bare = [f for f in findings if f.rule == "conc-bare-except"]
+        assert bare and not bare[0].suppressed  # malformed does not suppress
+
+    def test_suppression_only_covers_named_rules(self, tmp_path):
+        ctx = self._ctx(tmp_path, (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except:  # cclint: disable=tpu-host-sync -- wrong rule\n"
+            "        return None\n"
+        ))
+        findings = run_rules(ctx, rules=[RULES["conc-bare-except"]],
+                             check_unused=False)
+        bare = [f for f in findings if f.rule == "conc-bare-except"]
+        assert bare and not bare[0].suppressed
+
+    def test_docstring_example_does_not_register_suppression(self, tmp_path):
+        ctx = self._ctx(tmp_path, (
+            '"""Example in prose:\n'
+            "    x()  # cclint: disable=conc-bare-except -- looks real\n"
+            '"""\n'
+            "X = 1\n"
+        ))
+        src = ctx.files[0]
+        assert src.suppressions == {}
+
+
+class TestOutput:
+    def test_json_schema(self, tmp_path):
+        (tmp_path / "mod.py").write_text("def f(g):\n    while True:\n        g()\n")
+        ctx = build_context(tmp_path)
+        findings = run_rules(ctx, rules=[RULES["conc-unbounded-loop"]],
+                             check_unused=False)
+        doc = json.loads(render_json(findings, len(ctx.files),
+                                     ["conc-unbounded-loop"]))
+        assert doc["version"] == 1
+        assert doc["summary"]["unsuppressed"] == 1
+        assert doc["summary"]["byRule"] == {"conc-unbounded-loop": 1}
+        (f,) = doc["findings"]
+        assert f["rule"] == "conc-unbounded-loop" and f["path"] == "mod.py"
+
+    def test_human_output_mentions_path_line_rule(self, tmp_path):
+        (tmp_path / "mod.py").write_text("def f(g):\n    while True:\n        g()\n")
+        ctx = build_context(tmp_path)
+        findings = run_rules(ctx, rules=[RULES["conc-unbounded-loop"]],
+                             check_unused=False)
+        text = render_human(findings, len(ctx.files), 1)
+        assert "mod.py:2: conc-unbounded-loop" in text
+        assert "1 finding(s)" in text
+
+
+class TestCli:
+    def test_exit_clean_on_clean_tree(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("X = 1\n")
+        rc = cclint_main(["--root", str(tmp_path)])
+        assert rc == EXIT_CLEAN
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_exit_findings_and_json(self, tmp_path, capsys):
+        (tmp_path / "bad.py").write_text("def f(g):\n    while True:\n        g()\n")
+        rc = cclint_main(["--root", str(tmp_path), "--json"])
+        assert rc == EXIT_FINDINGS
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["unsuppressed"] >= 1
+
+    def test_exit_error_on_unknown_rule(self, capsys):
+        rc = cclint_main(["--rule", "no-such-rule"])
+        assert rc == EXIT_ERROR
+
+    def test_list_rules(self, capsys):
+        rc = cclint_main(["--list-rules"])
+        assert rc == EXIT_CLEAN
+        out = capsys.readouterr().out
+        for rid in ("tpu-host-sync", "conc-guarded-by", "reg-config-key-declared"):
+            assert rid in out
+
+    def test_changed_only_without_git_reports_all(self, tmp_path, capsys):
+        (tmp_path / "bad.py").write_text("def f(g):\n    while True:\n        g()\n")
+        rc = cclint_main(["--root", str(tmp_path), "--changed-only"])
+        # /tmp is not a repo: cclint warns and falls back to the full report
+        captured = capsys.readouterr()
+        if "git unavailable" in captured.err:
+            assert rc == EXIT_FINDINGS
+        else:  # running under an enclosing repo: bad.py is untracked => reported
+            assert rc == EXIT_FINDINGS
+
+    def test_single_rule_selection(self, tmp_path, capsys):
+        (tmp_path / "bad.py").write_text(
+            "def f(g):\n"
+            "    while True:\n"
+            "        g()\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        pass\n"
+        )
+        rc = cclint_main(["--root", str(tmp_path), "--rule", "conc-bare-except",
+                          "--json"])
+        assert rc == EXIT_FINDINGS
+        doc = json.loads(capsys.readouterr().out)
+        assert set(doc["summary"]["byRule"]) == {"conc-bare-except"}
+
+
+class TestKernelScoping:
+    def test_marker_opts_a_module_in(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "# cclint: kernel-module\nimport numpy as np\n\n\n"
+            "def f(x):\n    return np.asarray(x)\n"
+        )
+        ctx = build_context(tmp_path)
+        assert ctx.files[0].is_kernel
+        findings = run_rules(ctx, rules=[RULES["tpu-host-sync"]],
+                             check_unused=False)
+        assert findings and findings[0].rule == "tpu-host-sync"
+
+    def test_unmarked_module_is_out_of_scope(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            "import numpy as np\n\n\ndef f(x):\n    return np.asarray(x)\n"
+        )
+        ctx = build_context(tmp_path)
+        assert not ctx.files[0].is_kernel
+        assert run_rules(ctx, rules=[RULES["tpu-host-sync"]],
+                         check_unused=False) == []
+
+    def test_package_kernel_modules_detected(self):
+        root = pathlib.Path(__file__).resolve().parents[1]
+        ctx = build_context(root)
+        kernels = {f.rel for f in ctx.kernel_files}
+        assert "cruise_control_tpu/analyzer/bulk.py" in kernels
+        assert "cruise_control_tpu/models/flat_model.py" in kernels
+        assert any(k.startswith("cruise_control_tpu/analyzer/goals/") for k in kernels)

@@ -59,6 +59,17 @@ fallback is impossible to miss, and when a fallback happened the tunnel is
 re-probed before each remaining config — on recovery the process re-execs
 itself so the larger configs still produce TPU numbers.
 
+Provenance: every record embeds an environment "fingerprint" block
+(platform, device kind+count, jax/jaxlib versions, git sha, probeFallback —
+common/telemetry.py) and emit() refuses to write a record whose metric label
+contradicts it: a probe-fallback run claiming TPU exits with rc 3 before the
+line reaches stdout (the BENCH_r05 artifact-drift class, BASELINE.md).
+Detail records additionally carry the device-telemetry join (per-bucket
+program flops/bytes from XLA cost analysis, memory watermark, host<->device
+transfer totals) and telemetryOverheadPct (<2% contract, like tracing).
+scripts/perf_gate.py diffs a fresh BENCH_DETAIL.json against the committed
+baseline with per-metric tolerances and stable exit codes.
+
 Usage: python bench.py [--smoke]        # --smoke = config 1 only, fast
 Env overrides: BENCH_CONFIG (single config 1-5), BENCH_SEED,
 BENCH_PROBE_TIMEOUT_S, BENCH_PROBE_RETRIES (default 3), BENCH_REPROBE=0 to
@@ -95,8 +106,36 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+#: environment fingerprint (set in main() after the platform probe); every
+#: record embeds it and emit() refuses platform-contradicting labels
+_FINGERPRINT: dict = {}
+
+
+def _platform_guard(payload: dict) -> None:
+    """Provenance gate: a record may not claim a platform its fingerprint
+    contradicts. The BENCH_r05 artifact recorded a "TPU" result that actually
+    ran `platform: cpu, probeFallback: true`; this exits nonzero (rc 3)
+    before such a line can reach stdout or the detail file."""
+    fp = payload.get("fingerprint") or _FINGERPRINT
+    actual = (fp.get("platform") or payload.get("platform") or "").lower()
+    metric = payload.get("metric", "").lower()
+    claims_tpu = "tpu" in metric or str(payload.get("platform", "")).lower() == "tpu"
+    if claims_tpu and (fp.get("probeFallback") or actual != "tpu"):
+        log(
+            "FATAL: metric claims TPU but the environment fingerprint says "
+            f"platform={fp.get('platform')!r} probeFallback={fp.get('probeFallback')!r}"
+            " — refusing to record a mislabeled result (see BASELINE.md r05 note)"
+        )
+        sys.exit(3)
+
+
 def emit(payload: dict, detail: dict | None = None) -> None:
-    """Compact line to stdout; full tables to BENCH_DETAIL.json + stderr."""
+    """Compact line to stdout; full tables to BENCH_DETAIL.json + stderr.
+    Every record embeds the environment fingerprint and passes the
+    platform-contradiction guard (exit 3 on a mislabeled platform)."""
+    if _FINGERPRINT:
+        payload.setdefault("fingerprint", _FINGERPRINT)
+    _platform_guard(payload)
     if detail:
         record = dict(payload)
         record.update(detail)
@@ -230,7 +269,9 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
     The timed pass runs under a bench root span, and the result carries its
     trace id + recompile/tracer-overhead deltas so _observability_block can
     scope the span summaries to exactly this measurement."""
+    from cruise_control_tpu.common.history import HISTORY
     from cruise_control_tpu.common.sensors import REGISTRY
+    from cruise_control_tpu.common.telemetry import TELEMETRY
     from cruise_control_tpu.common.tracing import TRACER
 
     t0 = time.monotonic()
@@ -241,6 +282,7 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
     log(f"[config {cfg_id}] {tag} warmup (compile) pass: {time.monotonic() - t0:.1f}s")
     recompiles0 = REGISTRY.meter("GoalOptimizer.program-cache-misses").snapshot()["count"]
     overhead0 = TRACER.overhead_s
+    telemetry0 = TELEMETRY.overhead_s + HISTORY.overhead_s
     t0 = time.monotonic()
     with TRACER.span(f"bench.{tag}", kind="bench", config=cfg_id) as root:
         result = optimizer.optimizations(model, raise_on_hard_failure=False, **kw)
@@ -251,6 +293,9 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
         - recompiles0
     )
     result._bench_tracing_overhead_s = TRACER.overhead_s - overhead0
+    result._bench_telemetry_overhead_s = (
+        TELEMETRY.overhead_s + HISTORY.overhead_s - telemetry0
+    )
     _log_pass(cfg_id, f"{tag} timed", wall, result)
     return wall, result
 
@@ -258,9 +303,12 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
 def _observability_block(result, wall: float) -> dict:
     """Why the run was fast or slow, not just totals (BENCH_DETAIL.json):
     per-goal spans (engine/rounds/converged), rounds by engine, recompile
-    count, the round-time histogram (p50/p95/p99), tracer overhead vs the
-    proposal wall (acceptance gate: <2%), and the sensor-registry snapshot."""
+    count, the round-time histogram (p50/p95/p99), tracer + telemetry/history
+    overhead vs the proposal wall (acceptance gates: <2% each), the device
+    telemetry join (per-bucket program cost, memory watermark, transfer
+    totals), and the sensor-registry snapshot."""
     from cruise_control_tpu.common.sensors import REGISTRY
+    from cruise_control_tpu.common.telemetry import TELEMETRY
     from cruise_control_tpu.common.tracing import TRACER
 
     tid = getattr(result, "_bench_trace_id", None)
@@ -282,6 +330,7 @@ def _observability_block(result, wall: float) -> dict:
         rounds_by_engine[eng] = rounds_by_engine.get(eng, 0) + int(a.get("rounds") or 0)
     snap = REGISTRY.snapshot()
     overhead = float(getattr(result, "_bench_tracing_overhead_s", 0.0))
+    telemetry_overhead = float(getattr(result, "_bench_telemetry_overhead_s", 0.0))
     return {
         "goalSpans": goal_spans,
         "roundsByEngine": rounds_by_engine,
@@ -290,6 +339,11 @@ def _observability_block(result, wall: float) -> dict:
         "deviceCallTimer": snap.get("GoalOptimizer.device-call-timer"),
         "tracingOverheadS": round(overhead, 6),
         "tracingOverheadPct": round(100.0 * overhead / max(wall, 1e-9), 4),
+        "telemetryOverheadS": round(telemetry_overhead, 6),
+        "telemetryOverheadPct": round(
+            100.0 * telemetry_overhead / max(wall, 1e-9), 4
+        ),
+        "telemetry": TELEMETRY.snapshot(),
         "spanSummary": TRACER.summarize(),
         "sensors": snap,
     }
@@ -466,6 +520,7 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         payload.update(_goal_payload_fields(add_result))
         obs = _observability_block(add_result, add_wall)
         payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
+        payload["telemetryOverheadPct"] = obs["telemetryOverheadPct"]
         detail = {
             "goals": _goal_table(add_result),
             "observability": obs,
@@ -522,6 +577,7 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
     payload.update(_goal_payload_fields(result))
     obs = _observability_block(result, wall)
     payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
+    payload["telemetryOverheadPct"] = obs["telemetryOverheadPct"]
     detail = {
         "goals": _goal_table(result),
         "violatedAfter": result.violated_goals_after,
@@ -587,6 +643,16 @@ def main() -> None:
     platform = jax.default_backend()
     devices = jax.devices()
     log(f"backend: {platform}, devices: {devices}")
+
+    # environment fingerprint: the provenance block every record embeds
+    # (platform, device kind+count, versions, git sha, probe outcome) — the
+    # reason a CPU-fallback run can no longer record a TPU-labeled metric
+    from cruise_control_tpu.common.telemetry import TELEMETRY
+
+    global _FINGERPRINT
+    _FINGERPRINT = TELEMETRY.fingerprint(probe_fallback=probe.fallback)
+    _DETAIL["fingerprint"] = _FINGERPRINT
+    log(f"fingerprint: {json.dumps(_FINGERPRINT)}")
 
     mesh = None
     if len(devices) > 1:

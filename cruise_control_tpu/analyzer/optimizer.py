@@ -85,8 +85,10 @@ from cruise_control_tpu.analyzer.goals import goals_by_priority
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS, Goal
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal, proposal_diff
 from cruise_control_tpu.analyzer.stats import ClusterModelStats, compute_stats, stats_to_dict
+from cruise_control_tpu.common.history import HISTORY
 from cruise_control_tpu.common.resources import PartMetric
 from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.common.telemetry import TELEMETRY, tree_nbytes
 from cruise_control_tpu.common.tracing import TRACER, maybe_profile
 from cruise_control_tpu.config.balancing import BalancingConstraint
 from cruise_control_tpu.models.flat_model import FlatClusterModel
@@ -1220,6 +1222,10 @@ def _compile_cached(key, tag, dims, build):
             REGISTRY.histogram(
                 "GoalOptimizer.stack-compile-timer.bucket." + bucket_label(dims)
             ).record(compile_s)
+            # device telemetry: the program's XLA cost analysis (flops/bytes
+            # accessed) keyed by its shape bucket — GET /perf joins it with
+            # the per-bucket compile histogram above
+            TELEMETRY.record_program(tag, bucket_label(dims), ex)
             _COMPILED_STACKS[key] = ex
             while len(_COMPILED_STACKS) > _COMPILED_STACKS_MAX:
                 # bounded cache: bucket churn (many distinct cluster shapes
@@ -1543,6 +1549,11 @@ class GoalOptimizer:
             )
             while len(self._prep_cache) > 2:
                 self._prep_cache.popitem(last=False)
+            # a prep miss is the upload of every static model array; the hit
+            # path moves nothing (that asymmetry is what the h2d meter shows)
+            TELEMETRY.record_transfer("h2d", tree_nbytes((pmodel, static)))
+        # the aggregates input re-uploads each call (its output is donated)
+        TELEMETRY.record_transfer("h2d", tree_nbytes(pmodel.assignment))
         agg = _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
         if self._mesh is not None:
             from cruise_control_tpu.parallel.sharding import place_aggregates
@@ -1777,7 +1788,12 @@ class GoalOptimizer:
                 replicaMoves=result.num_replica_moves,
                 leadershipMoves=result.num_leadership_moves,
             )
-            return result
+        # advance the device-memory watermark and snapshot the sensor
+        # time-series at the proposal boundary (rate-limited; the history
+        # point records the registry as this computation left it)
+        TELEMETRY.update_memory()
+        HISTORY.record_boundary("proposal")
+        return result
 
     def _optimizations(
         self,
@@ -1840,6 +1856,10 @@ class GoalOptimizer:
         # point of the whole run).
         metrics, stats_before, stats_after, init_np, final_np = jax.device_get(
             (metrics, stats_before, stats_after, init_assignment, agg.assignment)
+        )
+        TELEMETRY.record_transfer(
+            "d2h",
+            tree_nbytes((metrics, stats_before, stats_after, init_np, final_np)),
         )
         if goal_durs is None:
             # fused mode: per-round latency is only observable as the stack

@@ -1,0 +1,333 @@
+"""Device telemetry: what the hardware did, not just how long it took.
+
+The PR-2 sensors and spans time host-visible intervals; this module records
+the device-side facts behind them, per compiled program in the PR-3 shape
+bucket ladder:
+
+  * **XLA cost analysis** — flops and bytes accessed per compiled program
+    (`jax.stages.Compiled.cost_analysis()`), keyed by the program's shape
+    bucket. The padded shape IS the program identity (optimizer.bucket_label),
+    so arithmetic intensity attributes to the bucket that pays it.
+  * **Device memory watermarks** — `device.memory_stats()` where the backend
+    supports it (TPU/GPU); on CPU the backend returns nothing, so the
+    watermark gracefully falls back to process RSS (flagged `fallback: 1`).
+  * **Host↔device transfer meters** — byte + call counts recorded at the
+    dispatch seams that actually move data: the `_prep_cache` miss path
+    (static model arrays up), the per-call aggregates transfer, and the one
+    result `device_get` per proposal computation (down).
+  * **An environment fingerprint** — platform, device kind + count,
+    jax/jaxlib versions, git sha, and the platform-probe fallback flag. The
+    fingerprint is the provenance block every `bench.py` record embeds and
+    the reason a CPU-fallback run can no longer masquerade as a TPU number
+    (the BENCH_r05 artifact-drift class).
+
+Everything surfaces through the process sensor registry (docs/OBSERVABILITY
+.md carries the rows) and `GET /perf` joins it with the per-bucket compile
+and round histograms. Collection is gated by `telemetry.enabled` and
+self-measures its overhead (`DeviceTelemetry.overhead-seconds`) so the
+bench's <2%-of-proposal-wall contract is asserted, not guessed.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import subprocess
+import threading
+import time
+from typing import Dict, List, Optional
+
+from cruise_control_tpu.common.sensors import REGISTRY
+
+#: cost_analysis() key -> fingerprintable camelCase field
+_COST_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytesAccessed",
+    "transcendentals": "transcendentals",
+}
+
+
+def tree_nbytes(tree) -> int:
+    """Total array bytes across a pytree (numpy or jax leaves)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(getattr(leaf, "nbytes", 0) or 0)
+    return total
+
+
+def _read_rss_bytes() -> Optional[int]:
+    """Process resident set size (the CPU-backend memory fallback)."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * os.sysconf("SC_PAGESIZE")
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _git_sha() -> Optional[str]:
+    """HEAD commit of the repo this package lives in (provenance, not vcs)."""
+    root = pathlib.Path(__file__).resolve().parents[2]
+    try:
+        out = subprocess.run(
+            ["git", "-C", str(root), "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and len(sha) >= 7 else None
+
+
+class DeviceTelemetry:
+    """Process-wide device-telemetry collector (one instance: `TELEMETRY`)."""
+
+    def __init__(self, enabled: bool = True):
+        self._lock = threading.Lock()
+        self._enabled = enabled  #: guarded_by(_lock)
+        #: (bucket, program tag) -> cost record; guarded_by(_lock)
+        self._programs: Dict = {}
+        self._bucket_gauges: set = set()  #: guarded_by(_lock)
+        self._memory: Dict = {}  #: guarded_by(_lock)
+        self._fingerprint_base: Optional[Dict] = None  #: guarded_by(_lock)
+        self._probe_fallback: Optional[bool] = None  #: guarded_by(_lock)
+        self._overhead_s = 0.0  #: guarded_by(_lock)
+
+    # -- configuration ---------------------------------------------------------
+
+    def configure(self, enabled: Optional[bool] = None) -> None:
+        with self._lock:
+            if enabled is not None:
+                self._enabled = bool(enabled)
+
+    @property
+    def enabled(self) -> bool:
+        with self._lock:
+            return self._enabled
+
+    @property
+    def overhead_s(self) -> float:
+        """Cumulative seconds spent inside telemetry collection."""
+        with self._lock:
+            return self._overhead_s
+
+    def set_probe_fallback(self, fallback: bool) -> None:
+        """Record the platform-probe outcome (platform_probe calls this; the
+        fingerprint refuses to forget a CPU fallback)."""
+        with self._lock:
+            self._probe_fallback = bool(fallback)
+
+    def _charge_locked(self, seconds: float) -> None:
+        self._overhead_s += seconds
+
+    # -- environment fingerprint -----------------------------------------------
+
+    def fingerprint(self, probe_fallback: Optional[bool] = None) -> Dict:
+        """The provenance block: platform, device kind/count, versions, git
+        sha, probe-fallback flag. Backend facts are cached after first use
+        (they cannot change within a process); `probe_fallback` overrides the
+        recorded probe outcome for this call."""
+        t0 = time.monotonic()
+        with self._lock:
+            base = self._fingerprint_base
+            recorded = self._probe_fallback
+        if base is None:
+            import jax
+
+            devices = jax.devices()
+            try:
+                import jaxlib
+
+                jaxlib_version = getattr(
+                    jaxlib, "__version__", None
+                ) or jaxlib.version.__version__
+            except (ImportError, AttributeError):
+                jaxlib_version = None
+            base = {
+                "platform": jax.default_backend(),
+                "deviceKind": devices[0].device_kind if devices else None,
+                "deviceCount": len(devices),
+                "jax": jax.__version__,
+                "jaxlib": jaxlib_version,
+                "gitSha": _git_sha(),
+            }
+            with self._lock:
+                self._fingerprint_base = base
+        fp = dict(base)
+        if probe_fallback is None:
+            probe_fallback = recorded
+        fp["probeFallback"] = bool(probe_fallback) if probe_fallback is not None else False
+        with self._lock:
+            self._charge_locked(time.monotonic() - t0)
+        return fp
+
+    # -- per-program XLA cost analysis -----------------------------------------
+
+    def record_program(self, tag: str, bucket: str, compiled) -> Optional[Dict]:
+        """Record a freshly compiled program's XLA cost analysis under its
+        shape bucket. Best-effort: a backend without cost analysis records
+        `costAvailable: False` instead of raising into the compile path."""
+        if not self.enabled:
+            return None
+        t0 = time.monotonic()
+        cost = None
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:  # cost analysis is advisory; never fail a compile
+            cost = None
+        if isinstance(cost, (list, tuple)):  # older jax: one dict per computation
+            cost = cost[0] if cost else None
+        record: Dict = {
+            "program": tag,
+            "bucket": bucket,
+            "costAvailable": isinstance(cost, dict),
+        }
+        if isinstance(cost, dict):
+            for key, field in _COST_KEYS.items():
+                v = cost.get(key)
+                if isinstance(v, (int, float)):
+                    record[field] = float(v)
+        with self._lock:
+            self._programs[(bucket, tag)] = record
+            register_gauge = (
+                bucket not in self._bucket_gauges
+                and globals().get("TELEMETRY") is self  # scratch instances
+                # (tests/harnesses) must not shadow the process collector
+            )
+            if register_gauge:
+                self._bucket_gauges.add(bucket)
+            self._charge_locked(time.monotonic() - t0)
+        if register_gauge:
+            REGISTRY.gauge(
+                f"DeviceTelemetry.program-cost.{bucket}",
+                lambda b=bucket: self._bucket_cost(b),
+            )
+        return record
+
+    def _bucket_cost(self, bucket: str) -> Dict:
+        """Flat numeric summary of one bucket's programs (the /metrics gauge)."""
+        with self._lock:
+            records = [r for (b, _), r in self._programs.items() if b == bucket]
+        out = {"programs": len(records), "flops": 0.0, "bytesAccessed": 0.0}
+        for r in records:
+            out["flops"] += r.get("flops", 0.0)
+            out["bytesAccessed"] += r.get("bytesAccessed", 0.0)
+        return out
+
+    def programs(self) -> List[Dict]:
+        """All recorded program cost records (the /perf payload rows)."""
+        with self._lock:
+            return [dict(r) for r in self._programs.values()]
+
+    # -- host<->device transfer meters -----------------------------------------
+
+    def record_transfer(self, direction: str, nbytes: int) -> None:
+        """One host↔device transfer of `nbytes` (`direction`: h2d | d2h)."""
+        if not self.enabled or nbytes is None:
+            return
+        t0 = time.monotonic()
+        if direction == "h2d":
+            REGISTRY.meter("DeviceTelemetry.host-to-device-bytes").mark(int(nbytes))
+            REGISTRY.meter("DeviceTelemetry.host-to-device-transfers").mark()
+        else:
+            REGISTRY.meter("DeviceTelemetry.device-to-host-bytes").mark(int(nbytes))
+            REGISTRY.meter("DeviceTelemetry.device-to-host-transfers").mark()
+        with self._lock:
+            self._charge_locked(time.monotonic() - t0)
+
+    def transfer_totals(self) -> Dict:
+        return {
+            "hostToDeviceBytes": REGISTRY.meter(
+                "DeviceTelemetry.host-to-device-bytes").snapshot()["count"],
+            "hostToDeviceTransfers": REGISTRY.meter(
+                "DeviceTelemetry.host-to-device-transfers").snapshot()["count"],
+            "deviceToHostBytes": REGISTRY.meter(
+                "DeviceTelemetry.device-to-host-bytes").snapshot()["count"],
+            "deviceToHostTransfers": REGISTRY.meter(
+                "DeviceTelemetry.device-to-host-transfers").snapshot()["count"],
+        }
+
+    # -- device memory watermarks ----------------------------------------------
+
+    def update_memory(self) -> Dict:
+        """Poll device memory stats and advance the peak watermark. TPU/GPU
+        report `bytes_in_use`/`peak_bytes_in_use`/`bytes_limit`; the CPU
+        backend reports nothing, so process RSS stands in (fallback: 1)."""
+        if not self.enabled:
+            return self.memory()
+        t0 = time.monotonic()
+        stats = None
+        try:
+            import jax
+
+            devices = jax.devices()
+            if devices:
+                stats = devices[0].memory_stats()
+        except Exception:  # a dead backend must not poison the caller
+            stats = None
+        with self._lock:
+            if stats:
+                self._memory["bytesInUse"] = int(stats.get("bytes_in_use", 0))
+                peak = int(
+                    stats.get("peak_bytes_in_use", self._memory["bytesInUse"])
+                )
+                self._memory["peakBytesInUse"] = max(
+                    self._memory.get("peakBytesInUse", 0), peak
+                )
+                if "bytes_limit" in stats:
+                    self._memory["bytesLimit"] = int(stats["bytes_limit"])
+                self._memory["fallback"] = 0
+            else:
+                rss = _read_rss_bytes()
+                if rss is not None:
+                    self._memory["bytesInUse"] = rss
+                    self._memory["peakBytesInUse"] = max(
+                        self._memory.get("peakBytesInUse", 0), rss
+                    )
+                    self._memory["fallback"] = 1
+            self._charge_locked(time.monotonic() - t0)
+            return dict(self._memory)
+
+    def memory(self) -> Dict:
+        """Last observed memory picture (never polls; the /metrics gauge)."""
+        with self._lock:
+            return dict(self._memory)
+
+    # -- aggregate views -------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """One joined record: programs + memory + transfers + overhead (the
+        bench detail block and /perf building block)."""
+        return {
+            "programs": self.programs(),
+            "memory": self.memory(),
+            "transfers": self.transfer_totals(),
+            "overheadS": round(self.overhead_s, 6),
+        }
+
+    def reset(self) -> None:
+        """Drop per-process program/memory records (tests/bench isolation);
+        registry meters are monotonic by contract and stay."""
+        with self._lock:
+            self._programs.clear()
+            self._memory.clear()
+            self._overhead_s = 0.0
+
+
+#: the process-wide collector (bench.py, the optimizer seams, GET /perf)
+TELEMETRY = DeviceTelemetry(
+    enabled=os.environ.get("CRUISE_CONTROL_TELEMETRY", "1") != "0"
+)
+
+
+def _register_telemetry_gauges() -> None:
+    # registered for the singleton only: a scratch DeviceTelemetry (tests,
+    # harnesses) must not shadow the process collector's /metrics rows
+    REGISTRY.gauge("DeviceTelemetry.device-memory", TELEMETRY.memory)
+    REGISTRY.gauge("DeviceTelemetry.overhead-seconds",
+                   lambda: round(TELEMETRY.overhead_s, 6))
+
+
+_register_telemetry_gauges()

@@ -391,6 +391,20 @@ def _config_def() -> ConfigDef:
              "Arm a one-shot JAX profiler capture: the first proposal computation "
              "after startup writes an xplane trace here (parse with "
              "scripts/parse_xplane.py); empty = disabled.")
+    d.define("observability.history.interval.s", Type.DOUBLE, 0.0, at_least(0.0), Importance.LOW,
+             "Cadence of the background sensor time-series sampler (GET /timeseries). "
+             "0 (the default) disables the sampler thread; snapshots still happen at "
+             "proposal/execution boundaries and on /timeseries scrapes.")
+    d.define("observability.history.ring.size", Type.INT, 512, at_least(16), Importance.LOW,
+             "Sensor-registry snapshots retained in the time-series ring; oldest "
+             "points drop first.")
+    d.define("observability.history.jsonl.path", Type.STRING, "", None, Importance.LOW,
+             "Append every history snapshot as one JSON line to this file (durable "
+             "time series, next to the trace JSONL sink); empty = disabled.")
+    d.define("telemetry.enabled", Type.BOOLEAN, True, None, Importance.LOW,
+             "Collect device telemetry (per-program XLA cost analysis, device memory "
+             "watermarks, host-device transfer meters) into the sensor registry and "
+             "GET /perf; disable to shave the (already <2%) collection overhead.")
     return d
 
 

@@ -363,6 +363,11 @@ class Executor:
                     self._monitor.resume_metric_sampling()
                 with self._lock:
                     self._state = ExecutorState.NO_TASK_IN_PROGRESS
+                # sensor time-series point at the execution boundary
+                # (rate-limited; docs/OBSERVABILITY.md history section)
+                from cruise_control_tpu.common.history import HISTORY
+
+                HISTORY.record_boundary("execution")
 
     # -- proposal drift validation ---------------------------------------------
 

@@ -15,7 +15,10 @@ instead of surfacing as a dead knob or an undocumented metric:
     the docs/OBSERVABILITY.md inventory, and one name may not be reused
     across sensor types (REGISTRY.snapshot() merges by name — a meter and
     a gauge sharing a name silently shadow each other);
-  * every span kind passed to the TRACER must be a documented kind.
+  * every span kind passed to the TRACER must be a documented kind;
+  * every REST endpoint the servlet registers must have a row in
+    docs/ENDPOINTS.md (an undocumented endpoint is API surface operators
+    cannot discover).
 
 F-string names (`f"Retry.{name}.retries"`) become fnmatch patterns
 (`Retry.*.retries`) and match the docs' placeholder spellings
@@ -290,6 +293,82 @@ class SensorCollisionRule(Rule):
                     f"sensor name `{name}` is registered as {method} here "
                     f"but also as {', '.join(sorted(methods - {method}))} "
                     "elsewhere — one will shadow the other in snapshots",
+                )
+
+
+#: the servlet wiring file and the doc carrying the endpoint inventory
+_SERVER_BASENAME = "server.py"
+_ENDPOINT_DOC_BASENAME = "ENDPOINTS.md"
+
+
+def _endpoint_registrations(ctx: LintContext):
+    """[(src, lineno, endpoint)] for every endpoint the servlet wires up:
+    `("name", self.handler)` tuples in the build_app endpoint lists, plus
+    literal route paths on `router.add_get/add_post` (the root scrape
+    aliases). Dynamic path segments (`{tail:...}`) and "/" are skipped."""
+    if "endpoint_registrations" in ctx.cache:
+        return ctx.cache["endpoint_registrations"]
+    out = []
+    for src in ctx.files_named(_SERVER_BASENAME):
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Tuple) and len(node.elts) == 2:
+                first, second = node.elts
+                if (
+                    isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)
+                    and first.value.isidentifier()
+                    and isinstance(second, ast.Attribute)
+                    and isinstance(second.value, ast.Name)
+                    and second.value.id == "self"
+                ):
+                    out.append((src, node.lineno, first.value))
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in ("add_get", "add_post")
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    seg = node.args[0].value.rstrip("/").rsplit("/", 1)[-1]
+                    if seg and "{" not in seg:
+                        out.append((src, node.lineno, seg))
+    ctx.cache["endpoint_registrations"] = out
+    return out
+
+
+@register
+class EndpointDocumentedRule(Rule):
+    id = "reg-endpoint-documented"
+    family = "registry"
+    rationale = (
+        "every REST endpoint the servlet serves must have a row in "
+        "docs/ENDPOINTS.md — an undocumented endpoint is API surface "
+        "operators cannot discover and clients cannot validate against"
+    )
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        if not ctx.docs:
+            return
+        texts = [
+            t for rel, t in ctx.docs.items()
+            if rel.endswith(_ENDPOINT_DOC_BASENAME)
+        ] or list(ctx.docs.values())
+        corpus = "\n".join(texts)
+        seen: Set[Tuple[str, str]] = set()
+        for src, lineno, name in _endpoint_registrations(ctx):
+            if (src.rel, name) in seen:  # root aliases duplicate the row
+                continue
+            seen.add((src.rel, name))
+            if f"`{name}`" not in corpus:
+                yield self.finding(
+                    src, lineno,
+                    f"endpoint `{name}` is registered but has no row in "
+                    f"{_ENDPOINT_DOC_BASENAME} — document its parameters "
+                    "and response shape",
                 )
 
 

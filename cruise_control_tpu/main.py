@@ -36,8 +36,11 @@ def build_simulated_service(
     (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`
     and the bulk count-planner knobs) map onto the goal engine through
     BalancingConstraint.from_config / OptimizerSettings.from_config, the
-    `observability.*` keys configure the span tracer (ring size, JSONL sink)
-    and arm the one-shot profiler capture (docs/OBSERVABILITY.md), and the
+    `observability.*` keys configure the span tracer (ring size, JSONL sink),
+    arm the one-shot profiler capture, and shape the sensor time-series
+    store (`observability.history.*` — ring size, JSONL sink, sampler
+    cadence) while `telemetry.enabled` gates the device-telemetry collector
+    (docs/OBSERVABILITY.md), and the
     resilience keys (`executor.task.deadline.s`, `executor.retry.*`,
     `executor.proposal.revalidate`, `executor.proposal.max.generation.skew`,
     `selfhealing.breaker.*`) shape the executor deadline/retry/drift-safety
@@ -115,12 +118,22 @@ def build_simulated_service(
             breaker_cooldown_s=cfg.get_double("selfhealing.breaker.cooldown.s"),
         )
         from cruise_control_tpu.common import tracing
+        from cruise_control_tpu.common.history import HISTORY
+        from cruise_control_tpu.common.telemetry import TELEMETRY
 
         tracing.TRACER.configure(
             ring_size=cfg.get_int("observability.trace.ring.size"),
             jsonl_path=cfg.get_string("observability.trace.jsonl.path") or None,
         )
         tracing.set_profile_dir(cfg.get_string("observability.profile.dir") or None)
+        # perf observatory: the sensor time-series store (GET /timeseries) and
+        # the device-telemetry collector (GET /perf) — docs/OBSERVABILITY.md
+        HISTORY.configure(
+            ring_size=cfg.get_int("observability.history.ring.size"),
+            jsonl_path=cfg.get_string("observability.history.jsonl.path"),
+            interval_s=cfg.get_double("observability.history.interval.s"),
+        )
+        TELEMETRY.configure(enabled=cfg.get_boolean("telemetry.enabled"))
     executor = Executor(
         SimulatorClusterDriver(sim, latency_polls=2),
         config=executor_config, load_monitor=monitor,
@@ -155,6 +168,10 @@ def start_background(parts, precompute_interval_s: float = 30.0,
         detection_interval_s=detection_interval_s
     )
     parts["detector"].start_detection()
+    # history sampler: a no-op unless observability.history.interval.s > 0
+    from cruise_control_tpu.common.history import HISTORY
+
+    HISTORY.start()
 
 
 def main(argv=None) -> int:

@@ -117,7 +117,7 @@ def ensure_live_backend(timeout_s: float = 75.0, log=None,
     if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
         pin_cpu()
         log("platform: cpu (pre-pinned via JAX_PLATFORMS)")
-        return ProbeResult(platform="cpu", fallback=False, attempts=0)
+        return _record(ProbeResult(platform="cpu", fallback=False, attempts=0))
     retries = max(1, int(retries))
     for attempt in range(1, retries + 1):
         log(
@@ -127,10 +127,20 @@ def ensure_live_backend(timeout_s: float = 75.0, log=None,
         name = probe_default_backend(timeout_s)
         if name is not None:
             log(f"platform: default backend live -> {name}")
-            return ProbeResult(platform=name, fallback=False, attempts=attempt)
+            return _record(ProbeResult(platform=name, fallback=False, attempts=attempt))
         if attempt < retries:
             log(f"probe {attempt} hung or failed; retrying in {backoff_s:.0f}s")
             time.sleep(backoff_s)
     pin_cpu()
     log(f"platform: default backend dead after {retries} probes -> pinned cpu")
-    return ProbeResult(platform="cpu", fallback=True, attempts=retries)
+    return _record(ProbeResult(platform="cpu", fallback=True, attempts=retries))
+
+
+def _record(result: ProbeResult) -> ProbeResult:
+    """Stamp the probe verdict into the telemetry fingerprint: a CPU fallback
+    must be visible in every provenance block downstream (the BENCH_r05
+    artifact-drift fix), not only in the caller that probed."""
+    from cruise_control_tpu.common.telemetry import TELEMETRY
+
+    TELEMETRY.set_probe_fallback(result.fallback)
+    return result

@@ -6,8 +6,9 @@ cc/servlet/EndPoint.java:38-57:
 
   GET  state, load, partition_load, proposals, kafka_cluster_state,
        user_tasks, review_board, bootstrap, train,
-       metrics, trace  (TPU-native observability; also at root /metrics and
-                        /trace — docs/OBSERVABILITY.md)
+       metrics, trace, timeseries, perf
+       (TPU-native observability; also at root /metrics, /trace,
+        /timeseries and /perf — docs/OBSERVABILITY.md)
   POST rebalance, add_broker, remove_broker, demote_broker,
        stop_proposal_execution, pause_sampling, resume_sampling,
        topic_configuration, admin, review
@@ -407,6 +408,99 @@ class CruiseControlApp:
             }
         )
 
+    async def timeseries(self, request) -> web.Response:
+        """Windowed sensor time-series from the history store
+        (docs/OBSERVABILITY.md): per-sensor first/last/delta/rate stats plus
+        step-downsampled series for the top movers. `name` (fnmatch pattern)
+        or `kind` (sensor-name prefix, e.g. `GoalOptimizer`) filter the
+        series set; `window`/`step` are seconds; `limit` bounds how many
+        series come back (ranked by |delta|). When no background sampler is
+        running, each scrape records one snapshot (scrape-driven sampling);
+        `snapshot=true|false` forces/suppresses that."""
+        from cruise_control_tpu.common.history import HISTORY
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("GET /timeseries", kind="timeseries"):
+            try:
+                window = request.query.get("window")
+                window_s = float(window) if window else None
+                step = request.query.get("step")
+                step_s = float(step) if step else None
+                limit = int(request.query.get("limit", "25"))
+            except ValueError:
+                return self._json(
+                    {"errorMessage": "window/step/limit must be numeric"},
+                    status=400,
+                )
+            pattern = request.query.get("name")
+            if pattern is None and request.query.get("kind"):
+                pattern = request.query["kind"] + ".*"
+            snap = request.query.get("snapshot", "auto").lower()
+            if snap in ("1", "true", "yes") or (
+                snap == "auto" and not HISTORY.sampler_running
+            ):
+                HISTORY.snapshot_now(reason="scrape")
+            query = HISTORY.query(pattern=pattern, window_s=window_s)
+            movers = sorted(
+                query, key=lambda n: -abs(query[n]["delta"])
+            )[: max(0, limit)]
+            return self._json(
+                {
+                    "query": query,
+                    "series": {
+                        n: HISTORY.series(n, window_s=window_s, step_s=step_s)
+                        for n in movers
+                    },
+                    "history": HISTORY.state(),
+                    "version": 1,
+                }
+            )
+
+    async def perf(self, request) -> web.Response:
+        """The perf observatory join (docs/OBSERVABILITY.md): per-bucket
+        compiled-program telemetry (flops/bytes accessed from XLA cost
+        analysis, joined with that bucket's compile histogram), device memory
+        watermarks, host↔device transfer totals, the hot optimizer timers,
+        the environment fingerprint, and the history store's state."""
+        from cruise_control_tpu.common.history import HISTORY
+        from cruise_control_tpu.common.sensors import REGISTRY
+        from cruise_control_tpu.common.telemetry import TELEMETRY
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("GET /perf", kind="perf"):
+            TELEMETRY.update_memory()
+            snap = REGISTRY.snapshot()
+            programs = []
+            for rec in TELEMETRY.programs():
+                row = dict(rec)
+                row["compile"] = snap.get(
+                    "GoalOptimizer.stack-compile-timer.bucket." + rec["bucket"]
+                )
+                programs.append(row)
+            try:
+                fingerprint = TELEMETRY.fingerprint()
+            except Exception as e:  # a dead backend must not 500 the join
+                fingerprint = {"error": f"{type(e).__name__}: {e}"}
+            return self._json(
+                {
+                    "fingerprint": fingerprint,
+                    "programs": programs,
+                    "memory": TELEMETRY.memory(),
+                    "transfers": TELEMETRY.transfer_totals(),
+                    "timers": {
+                        "proposalTimer": snap.get(
+                            "GoalOptimizer.proposal-computation-timer"
+                        ),
+                        "roundTimer": snap.get("GoalOptimizer.optimizer-round-timer"),
+                        "deviceCallTimer": snap.get("GoalOptimizer.device-call-timer"),
+                        "compileTimer": snap.get("GoalOptimizer.stack-compile-timer"),
+                    },
+                    "telemetryOverheadS": round(TELEMETRY.overhead_s, 6),
+                    "history": HISTORY.state(),
+                    "version": 1,
+                }
+            )
+
     async def review_board(self, request) -> web.Response:
         if self._purgatory is None:
             return self._json({"errorMessage": "2-step verification is disabled"}, status=400)
@@ -590,6 +684,7 @@ class CruiseControlApp:
             ("user_tasks", self.user_tasks), ("review_board", self.review_board),
             ("bootstrap", self.bootstrap), ("train", self.train),
             ("metrics", self.metrics), ("trace", self.trace),
+            ("timeseries", self.timeseries), ("perf", self.perf),
         ]
         p = [
             ("rebalance", self.rebalance), ("add_broker", self.add_broker),
@@ -607,6 +702,8 @@ class CruiseControlApp:
         # a mounted UI cannot shadow the Prometheus convention paths)
         app.router.add_get("/metrics", self.metrics)
         app.router.add_get("/trace", self.trace)
+        app.router.add_get("/timeseries", self.timeseries)
+        app.router.add_get("/perf", self.perf)
         if self._webui_dir:
             import os
 

@@ -15,7 +15,11 @@ Output (stdout):
   4. the resilience picture: self-healing circuit-breaker states and the
      retry/dead-task/dispatch-failure counters (docs/RESILIENCE.md),
   5. the proposal drift/validation picture: trimmed-by-reason counts, the
-     generation-skew gauge, and the batch-abort counter.
+     generation-skew gauge, and the batch-abort counter,
+  6. the perf observatory: device telemetry (per-bucket program flops/bytes
+     from XLA cost analysis, device-memory watermark, host<->device transfer
+     totals) and the top time-series movers from /timeseries
+     (docs/OBSERVABILITY.md telemetry section).
 
 --raw additionally prints the raw Prometheus exposition text.
 """
@@ -186,6 +190,104 @@ def _drift_section(text: str) -> None:
             print(f"   trimmed[{reason}]".ljust(55) + f"{count:>8}")
 
 
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:7.1f}{unit}"
+        v /= 1024.0
+    return f"{v:.1f}TiB"
+
+
+def _fmt_count(v: float) -> str:
+    for unit in ("", "K", "M", "G", "T", "P"):
+        if abs(v) < 1000.0 or unit == "P":
+            return f"{v:7.2f}{unit}"
+        v /= 1000.0
+    return f"{v:.2f}P"
+
+
+def _perf_section(text: str) -> None:
+    """Device telemetry (docs/OBSERVABILITY.md): per-bucket compiled-program
+    cost, the memory watermark, and host<->device transfer totals."""
+    buckets = {}
+    memory = {}
+    transfers = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        labels = _parse_labels(labels_raw)
+        sensor = labels.get("sensor", "")
+        if name == "cruise_control_gauge":
+            if sensor.startswith("DeviceTelemetry.program-cost."):
+                bucket = sensor[len("DeviceTelemetry.program-cost."):]
+                buckets.setdefault(bucket, {})[labels.get("field", "")] = float(value)
+            elif sensor == "DeviceTelemetry.device-memory":
+                memory[labels.get("field", "")] = float(value)
+        elif name == "cruise_control_meter_total" and sensor.startswith(
+            "DeviceTelemetry."
+        ):
+            transfers[sensor.rsplit(".", 1)[1]] = float(value)
+    print("\n== device telemetry (per-bucket program cost) ==")
+    if not buckets:
+        print("   (no program-cost gauges exported — nothing compiled yet)")
+    else:
+        header = f"{'bucket':<28} {'programs':>8} {'flops':>9} {'bytesAccessed':>13}"
+        print(header)
+        print("-" * len(header))
+        for bucket, fields in sorted(
+            buckets.items(), key=lambda kv: -kv[1].get("flops", 0.0)
+        ):
+            print(
+                f"{bucket:<28} {int(fields.get('programs', 0)):>8} "
+                f"{_fmt_count(fields.get('flops', 0.0)):>9} "
+                f"{_fmt_bytes(fields.get('bytesAccessed', 0.0)):>13}"
+            )
+    if memory:
+        fb = " (process RSS fallback)" if memory.get("fallback") else ""
+        print(
+            f"   device memory: in use {_fmt_bytes(memory.get('bytesInUse', 0))}, "
+            f"peak {_fmt_bytes(memory.get('peakBytesInUse', 0))}{fb}"
+        )
+    if transfers:
+        print(
+            f"   transfers: h2d {_fmt_bytes(transfers.get('host-to-device-bytes', 0))}"
+            f" in {int(transfers.get('host-to-device-transfers', 0))} call(s), "
+            f"d2h {_fmt_bytes(transfers.get('device-to-host-bytes', 0))}"
+            f" in {int(transfers.get('device-to-host-transfers', 0))} call(s)"
+        )
+
+
+def _timeseries_movers(base: str, top: int = 10) -> None:
+    """Top sensor movers over the /timeseries window (absent on servers
+    predating the history store — degrade, don't die)."""
+    print(f"\n== time-series movers (top {top} by |delta|) ==")
+    try:
+        doc = json.loads(_get(f"{base}/timeseries?limit={top}"))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"   (no /timeseries endpoint: {e})")
+        return
+    query = doc.get("query") or {}
+    movers = sorted(query.items(), key=lambda kv: -abs(kv[1]["delta"]))[:top]
+    if not movers:
+        print("   (history store is empty)")
+        return
+    h = doc.get("history") or {}
+    for name, s in movers:
+        if not s["delta"]:
+            continue
+        print(
+            f"   {name:<58} {s['first']:>12.3f} -> {s['last']:>12.3f} "
+            f"({s['delta']:+.3f}, {s['ratePerS']:+.4f}/s over {s['n']} pts)"
+        )
+    print(
+        f"   history: {h.get('points', 0)}/{h.get('capacity', 0)} points, "
+        f"sampler {'running' if h.get('samplerRunning') else 'off (scrape-driven)'}, "
+        f"overhead {h.get('overheadS', 0.0)}s"
+    )
+
+
 def _sensor_table(text: str) -> None:
     latencies = _parse_prometheus_latencies(text)
     print("\n== sensors (ranked by total seconds) ==")
@@ -217,6 +319,8 @@ def main() -> int:
     _sensor_table(metrics_text)
     _resilience_section(metrics_text)
     _drift_section(metrics_text)
+    _perf_section(metrics_text)
+    _timeseries_movers(base)
     print(f"\ntracer overhead: {trace.get('overheadS', 0.0):.6f}s")
     if args.raw:
         print("\n== raw /metrics ==")

@@ -1,0 +1,227 @@
+"""Machine-checkable bench regression gate.
+
+Diffs a fresh BENCH_DETAIL.json against a committed baseline with per-metric
+tolerances, so perf regressions fail a pipeline instead of hiding in prose
+(BASELINE.md has twice drifted from the recorded artifacts — the round-5
+verdict's open hinge). The inputs are the detail files bench.py writes;
+records pair up by their BASELINE config (parsed from the metric string,
+falling back to file order).
+
+Checks per config pair (each individually tolerable):
+  wall             candidate value <= baseline * (1 + --tol-wall)
+  rounds           total goalRounds <= baseline * (1 + --tol-rounds)
+  moves            |candidate - baseline| <= baseline * --tol-moves
+  programsCompiled candidate <= baseline + --tol-programs  (compile-
+                   amortization regressions are absolute, not relative)
+  parityOk         may not flip true -> false
+
+Provenance checks (the r05 class):
+  * candidate records missing a fingerprint block fail (bench.py now always
+    embeds one; an unfingerprinted candidate is an untrusted artifact) —
+    unless --allow-unfingerprinted (for gating historical baselines).
+  * candidate platform must equal baseline platform (a cpu-vs-tpu wall diff
+    is meaningless): exit 4, or pass --allow-platform-mismatch to compare
+    anyway (wall/rounds checks are then skipped, provenance-only).
+
+Exit codes (stable; CI scripts may match on them):
+  0  pass
+  1  regression (any tolerance exceeded or parity flip)
+  2  usage / unreadable input
+  4  platform mismatch between candidate and baseline fingerprints
+
+Usage:
+  python scripts/perf_gate.py BASELINE_DETAIL.json CANDIDATE_DETAIL.json \
+      [--tol-wall 0.30] [--tol-rounds 0.25] [--tol-moves 0.25] \
+      [--tol-programs 0] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from typing import Dict, List, Optional
+
+EXIT_PASS = 0
+EXIT_REGRESSION = 1
+EXIT_ERROR = 2
+EXIT_PLATFORM_MISMATCH = 4
+
+_CONFIG_RE = re.compile(r"BASELINE config (\d+)")
+
+
+def _load(path: str) -> Dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"perf_gate: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_ERROR)
+    if not isinstance(doc, dict) or not isinstance(doc.get("configs"), list):
+        print(f"perf_gate: {path} is not a BENCH_DETAIL file "
+              "(expected top-level {'configs': [...]})", file=sys.stderr)
+        raise SystemExit(EXIT_ERROR)
+    return doc
+
+
+def _config_id(record: Dict, index: int) -> str:
+    m = _CONFIG_RE.search(record.get("metric", ""))
+    return m.group(1) if m else f"#{index}"
+
+
+def _pair_records(base: Dict, cand: Dict) -> List:
+    base_by_id = {
+        _config_id(r, i): r for i, r in enumerate(base["configs"])
+    }
+    out = []
+    for i, c in enumerate(cand["configs"]):
+        cid = _config_id(c, i)
+        b = base_by_id.get(cid)
+        if b is not None:
+            out.append((cid, b, c))
+    return out
+
+
+def _total_rounds(record: Dict) -> Optional[int]:
+    rounds = record.get("goalRounds")
+    if not isinstance(rounds, dict):
+        return None
+    return sum(int(v) for v in rounds.values())
+
+
+def _fingerprint(doc: Dict, record: Dict) -> Dict:
+    fp = record.get("fingerprint") or doc.get("fingerprint")
+    return fp if isinstance(fp, dict) else {}
+
+
+class Gate:
+    def __init__(self, args):
+        self.args = args
+        self.checks: List[Dict] = []
+        self.failed = False
+
+    def check(self, cid: str, name: str, ok: bool, detail: str) -> None:
+        self.checks.append(
+            {"config": cid, "check": name, "ok": bool(ok), "detail": detail}
+        )
+        if not ok:
+            self.failed = True
+
+    def compare_pair(self, cid: str, b: Dict, c: Dict, walls: bool) -> None:
+        a = self.args
+        if walls:
+            bw, cw = float(b.get("value", -1)), float(c.get("value", -1))
+            if bw > 0 and cw > 0:
+                limit = bw * (1.0 + a.tol_wall)
+                self.check(
+                    cid, "wall", cw <= limit,
+                    f"wall {cw:.3f}s vs baseline {bw:.3f}s "
+                    f"(limit {limit:.3f}s, tol {a.tol_wall:+.0%})",
+                )
+            br, cr = _total_rounds(b), _total_rounds(c)
+            if br and cr is not None:
+                limit_r = br * (1.0 + a.tol_rounds)
+                self.check(
+                    cid, "rounds", cr <= limit_r,
+                    f"total rounds {cr} vs baseline {br} "
+                    f"(limit {limit_r:.0f}, tol {a.tol_rounds:+.0%})",
+                )
+        bm, cm = b.get("moves"), c.get("moves")
+        if isinstance(bm, int) and isinstance(cm, int) and bm > 0:
+            slack = bm * a.tol_moves
+            self.check(
+                cid, "moves", abs(cm - bm) <= slack,
+                f"moves {cm} vs baseline {bm} (slack +-{slack:.0f})",
+            )
+        bp, cp = b.get("programsCompiled"), c.get("programsCompiled")
+        if isinstance(bp, int) and isinstance(cp, int):
+            self.check(
+                cid, "programsCompiled", cp <= bp + a.tol_programs,
+                f"programs {cp} vs baseline {bp} (+{a.tol_programs} allowed)",
+            )
+        if b.get("parityOk") is True:
+            self.check(
+                cid, "parityOk", c.get("parityOk") is True,
+                f"parityOk {c.get('parityOk')} vs baseline True",
+            )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="diff a fresh BENCH_DETAIL.json against a committed baseline"
+    )
+    parser.add_argument("baseline", help="committed BENCH_DETAIL.json")
+    parser.add_argument("candidate", help="fresh BENCH_DETAIL.json to gate")
+    parser.add_argument("--tol-wall", type=float, default=0.30,
+                        help="relative wall-clock slack (default +30%%)")
+    parser.add_argument("--tol-rounds", type=float, default=0.25,
+                        help="relative total-goal-rounds slack (default +25%%)")
+    parser.add_argument("--tol-moves", type=float, default=0.25,
+                        help="relative replica-move-count slack (default +-25%%)")
+    parser.add_argument("--tol-programs", type=int, default=0,
+                        help="absolute extra compiled programs allowed (default 0)")
+    parser.add_argument("--allow-platform-mismatch", action="store_true",
+                        help="compare across platforms (wall/round checks skipped)")
+    parser.add_argument("--allow-unfingerprinted", action="store_true",
+                        help="accept candidate records with no fingerprint block")
+    parser.add_argument("--json", action="store_true", help="machine output")
+    args = parser.parse_args(argv)
+
+    base = _load(args.baseline)
+    cand = _load(args.candidate)
+    pairs = _pair_records(base, cand)
+    if not pairs:
+        print("perf_gate: no overlapping configs between baseline and candidate",
+              file=sys.stderr)
+        return EXIT_ERROR
+
+    gate = Gate(args)
+    platform_mismatch = False
+    for cid, b, c in pairs:
+        bfp, cfp = _fingerprint(base, b), _fingerprint(cand, c)
+        if not cfp and not args.allow_unfingerprinted:
+            gate.check(
+                cid, "fingerprint", False,
+                "candidate record carries no environment fingerprint "
+                "(re-run with the current bench.py, or --allow-unfingerprinted)",
+            )
+        walls = True
+        b_platform = bfp.get("platform") or b.get("platform")
+        c_platform = cfp.get("platform") or c.get("platform")
+        if b_platform and c_platform and b_platform != c_platform:
+            platform_mismatch = True
+            walls = False  # cross-platform wall/round diffs are meaningless
+            gate.check(
+                cid, "platform", args.allow_platform_mismatch,
+                f"candidate platform {c_platform!r} vs baseline {b_platform!r}",
+            )
+        if cfp.get("probeFallback") and c_platform != "cpu":
+            gate.check(
+                cid, "probeFallback", False,
+                "candidate fingerprint has probeFallback=true but a "
+                f"non-cpu platform label ({c_platform!r}) — mislabeled artifact",
+            )
+        gate.compare_pair(cid, b, c, walls=walls)
+
+    if args.json:
+        print(json.dumps(
+            {"checks": gate.checks,
+             "pass": not gate.failed and not (
+                 platform_mismatch and not args.allow_platform_mismatch)},
+            indent=1,
+        ))
+    else:
+        for ch in gate.checks:
+            marker = "ok  " if ch["ok"] else "FAIL"
+            print(f"{marker} config {ch['config']:<3} {ch['check']:<16} {ch['detail']}")
+        n_fail = sum(1 for ch in gate.checks if not ch["ok"])
+        print(f"perf_gate: {len(gate.checks)} check(s), {n_fail} failure(s) "
+              f"over {len(pairs)} config pair(s)")
+    if platform_mismatch and not args.allow_platform_mismatch:
+        return EXIT_PLATFORM_MISMATCH
+    return EXIT_REGRESSION if gate.failed else EXIT_PASS
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

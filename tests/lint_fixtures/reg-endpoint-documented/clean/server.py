@@ -1,0 +1,18 @@
+class App:
+    async def timeseries(self, request):
+        return {}
+
+    async def state(self, request):
+        return {}
+
+    def build_app(self, app):
+        g = [
+            ("state", self.state),
+            ("timeseries", self.timeseries),
+        ]
+        for name, handler in g:
+            app.router.add_get(f"/api/{name}", handler)
+        app.router.add_get("/timeseries", self.timeseries)  # documented alias
+        app.router.add_get("/", self.state)  # bare root: out of scope
+        app.router.add_get("/{tail:.+}", self.state)  # dynamic: out of scope
+        return app

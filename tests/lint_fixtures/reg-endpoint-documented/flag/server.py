@@ -1,0 +1,16 @@
+class App:
+    async def timeseries(self, request):
+        return {}
+
+    async def state(self, request):
+        return {}
+
+    def build_app(self, app):
+        g = [
+            ("state", self.state),
+            ("timeseries", self.timeseries),  # not in ENDPOINTS.md -> finding
+        ]
+        for name, handler in g:
+            app.router.add_get(f"/api/{name}", handler)
+        app.router.add_get("/perf", self.timeseries)  # literal alias, undocumented
+        return app

@@ -469,6 +469,68 @@ def test_state_survives_raising_gauge(server):
         REGISTRY._gauges.pop("test.raising-gauge", None)
 
 
+def test_timeseries_endpoint_serves_real_data(server):
+    """/timeseries over a live server: scrape-driven snapshots (no sampler
+    running), windowed query stats, series shape, and filters."""
+    server["monitor"].cluster_model()  # move at least one sensor
+    for path in ("/timeseries", "/kafkacruisecontrol/timeseries"):
+        status, _, body = _http_get(server["url"] + path)
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["version"] == 1
+        assert payload["history"]["points"] >= 1  # the scrape snapshotted
+        assert payload["query"], "expected per-sensor stats"
+    # two scrapes later there is a real series to window over
+    status, _, body = _http_get(
+        server["url"] + "/timeseries?name=LoadMonitor.*&window=3600&limit=5"
+    )
+    payload = json.loads(body)
+    assert all(n.startswith("LoadMonitor.") for n in payload["query"])
+    name, stats = next(iter(payload["query"].items()))
+    assert {"n", "first", "last", "delta", "ratePerS", "p50", "p95"} <= set(stats)
+    assert stats["n"] >= 2
+    series = payload["series"][name]
+    assert series and len(series[0]) == 2  # [t, value] points
+    # kind= prefix filter spelling
+    status, _, body = _http_get(server["url"] + "/timeseries?kind=Tracer&limit=3")
+    assert all(n.startswith("Tracer.") for n in json.loads(body)["query"])
+    # bad window is a 400, not a 500
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _http_get(server["url"] + "/timeseries?window=nope")
+    assert err.value.code == 400
+
+
+def test_perf_endpoint_joins_telemetry(server):
+    from cruise_control_tpu.common.telemetry import TELEMETRY
+
+    # a recorded program must show up joined with its bucket histogram
+    class FakeCompiled:
+        def cost_analysis(self):
+            return {"flops": 64.0, "bytes accessed": 128.0}
+
+    TELEMETRY.record_program("test-join", "Ptest-B1-T1-RF1", FakeCompiled())
+    for path in ("/perf", "/kafkacruisecontrol/perf"):
+        status, _, body = _http_get(server["url"] + path)
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["version"] == 1
+        assert payload["fingerprint"]["platform"] == "cpu"
+        assert "probeFallback" in payload["fingerprint"]
+        assert payload["memory"].get("bytesInUse", 0) > 0  # polled on request
+        assert {"hostToDeviceBytes", "deviceToHostBytes"} <= set(payload["transfers"])
+        rows = [p for p in payload["programs"] if p["program"] == "test-join"]
+        assert rows and rows[0]["flops"] == 64.0
+        assert "compile" in rows[0]  # joined (None: no compile in this bucket)
+        assert "history" in payload and "timers" in payload
+    # the request itself traced under the documented kinds
+    from cruise_control_tpu.common.tracing import TRACER
+
+    kinds = {s["kind"] for s in TRACER.recent(limit=50)}
+    assert {"perf", "timeseries"} <= kinds
+
+
 def test_detector_sweep_emits_span(server):
     """Stub detectors: the real GoalViolationDetector dry-runs the anomaly
     goal stack (an XLA compile this module deliberately avoids); span
@@ -533,3 +595,48 @@ def test_observability_config_keys_reach_tracer(tmp_path):
         )
     finally:
         TRACER.configure(ring_size=old_ring, jsonl_path=old_path)
+
+
+def test_history_and_telemetry_config_keys_reach_stores(tmp_path):
+    from cruise_control_tpu.common.history import HISTORY
+    from cruise_control_tpu.common.telemetry import TELEMETRY
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({})
+    assert cfg.get_double("observability.history.interval.s") == 0.0
+    assert cfg.get_int("observability.history.ring.size") == 512
+    assert cfg.get_string("observability.history.jsonl.path") == ""
+    assert cfg.get_boolean("telemetry.enabled") is True
+
+    jsonl = tmp_path / "history.jsonl"
+    props = tmp_path / "cc.properties"
+    props.write_text(
+        "observability.history.ring.size=64\n"
+        f"observability.history.jsonl.path={jsonl}\n"
+        "telemetry.enabled=false\n"
+    )
+    old_state = HISTORY.state()
+    old_enabled = TELEMETRY.enabled
+    try:
+        from cruise_control_tpu.main import build_simulated_service
+
+        build_simulated_service(
+            num_brokers=4, num_racks=2, num_topics=3, config_path=str(props)
+        )
+        assert HISTORY.state()["capacity"] == 64
+        assert TELEMETRY.enabled is False
+        HISTORY.snapshot_now("cfg-roundtrip")
+        assert jsonl.exists()
+        assert any(
+            json.loads(l)["reason"] == "cfg-roundtrip"
+            for l in jsonl.read_text().splitlines()
+        )
+        # interval stayed 0: no sampler thread got started anywhere
+        assert not HISTORY.sampler_running
+    finally:
+        HISTORY.configure(
+            ring_size=old_state["capacity"],
+            jsonl_path=old_state["jsonlPath"] or "",
+            interval_s=old_state["intervalS"],
+        )
+        TELEMETRY.configure(enabled=old_enabled)

@@ -77,7 +77,16 @@ disable mid-run re-probing, BENCH_STAGES (comma list, default "1,2,3,4,5"),
 BENCH_PARITY=0 to skip the greedy passes, BENCH_PARITY5_BROKERS (parity
 model size for config 5, default 520), BENCH_GREEDY_CEILING (greedy
 cost-scaled round-cap ceiling, default 4096), BENCH_POLISH_ROUNDS (batched
-full-table polish pass budget per goal, default 48; 0 disables).
+full-table polish pass budget per goal, default 48; 0 disables),
+BENCH_LEDGER_DIR (write every timed pass's decision-provenance RunLedger —
+analyzer/provenance.py — as ledger_cfg<N>_<tag>.json there; feed a pair to
+scripts/diff_runs.py to pinpoint the first divergent move between runs).
+
+Each compact line also carries `provenanceDigest` — the 16-hex checksum of
+the run's canonical move list + per-goal cost deltas (the MoveLedger
+digest). Two runs with equal digests made the SAME decisions; a digest flip
+at equal parity is silent decision drift, which scripts/perf_gate.py flags
+as its own exit path (5).
 """
 
 from __future__ import annotations
@@ -297,7 +306,36 @@ def _timed(optimizer, model, cfg_id, tag, **kw):
         TELEMETRY.overhead_s + HISTORY.overhead_s - telemetry0
     )
     _log_pass(cfg_id, f"{tag} timed", wall, result)
+    _dump_ledger(cfg_id, tag, result)
     return wall, result
+
+
+def _dump_ledger(cfg_id: int, tag: str, result) -> None:
+    """BENCH_LEDGER_DIR: persist this pass's RunLedger for diff_runs.py
+    (ledger_cfg<N>_<tag>.json; best-effort, the bench line is the contract)."""
+    out_dir = os.environ.get("BENCH_LEDGER_DIR")
+    if not out_dir or result.provenance is None:
+        return
+    safe = tag.replace(" ", "_").replace("/", "-")
+    path = os.path.join(out_dir, f"ledger_cfg{cfg_id}_{safe}.json")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"config": cfg_id, "tag": tag,
+                       "ledger": result.provenance.to_dict()}, f)
+        log(f"[config {cfg_id}] {tag} ledger: {path} "
+            f"({len(result.provenance.moves)} moves)")
+    except OSError as e:
+        log(f"[config {cfg_id}] {tag} ledger write failed: {e}")
+
+
+def _provenance_fields(result) -> tuple:
+    """(compact checksum or None, detail digest block or None)."""
+    led = result.provenance
+    if led is None:
+        return None, None
+    digest = led.digest()
+    return digest["checksum"], {"runId": led.run_id, **digest}
 
 
 def _observability_block(result, wall: float) -> dict:
@@ -521,10 +559,14 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         obs = _observability_block(add_result, add_wall)
         payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
         payload["telemetryOverheadPct"] = obs["telemetryOverheadPct"]
+        checksum, prov_block = _provenance_fields(add_result)
+        if checksum:
+            payload["provenanceDigest"] = checksum
         detail = {
             "goals": _goal_table(add_result),
             "observability": obs,
             "bucketed": _bucketed_block(add_result, compile0),
+            **({"provenance": prov_block} if prov_block else {}),
         }
         payload["programsCompiled"] = _compile_counters()["programs"]
         payload["compileSTotal"] = _compile_counters()["compileS"]
@@ -578,11 +620,15 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
     obs = _observability_block(result, wall)
     payload["tracingOverheadPct"] = obs["tracingOverheadPct"]
     payload["telemetryOverheadPct"] = obs["telemetryOverheadPct"]
+    checksum, prov_block = _provenance_fields(result)
+    if checksum:
+        payload["provenanceDigest"] = checksum
     detail = {
         "goals": _goal_table(result),
         "violatedAfter": result.violated_goals_after,
         "observability": obs,
         "bucketed": _bucketed_block(result, compile0),
+        **({"provenance": prov_block} if prov_block else {}),
     }
     payload["programsCompiled"] = _compile_counters()["programs"]
     payload["compileSTotal"] = _compile_counters()["compileS"]

@@ -65,6 +65,7 @@ from cruise_control_tpu.analyzer.context import (
     Aggregates,
     StaticCtx,
     apply_actions_batch,
+    make_touch_tag,
     rank_paired_destinations,
     replicas_on_dead,
     wave_select,
@@ -170,7 +171,9 @@ def make_bulk_count_round(goal, dims, k_cand: int, max_waves: int):
                     jnp.isfinite(best), b_count, dims.num_hosts,
                     parts=(act.p,), num_partitions=p_count,
                 )
-                agg_c = apply_actions_batch(static, agg_c, act, w_sel)
+                agg_c = apply_actions_batch(
+                    static, agg_c, act, w_sel, tag=make_touch_tag(rnd, w)
+                )
                 # an applied row's candidate left its source (or its
                 # leadership moved): retire it so later waves consume the
                 # next candidate

@@ -147,6 +147,23 @@ class Aggregates(NamedTuple):
     rack_replica_count: jax.Array  # i32[P, NR] replicas of p on each rack
     topic_replica_count: jax.Array  # i32[T, B]
     host_cpu_load: jax.Array  # f32[H]
+    #: provenance attribution: packed (round, wave) tag of the last accepted
+    #: action that wrote each assignment cell (`make_touch_tag`; -1 = never
+    #: touched this run). Rides every apply alongside the assignment writes —
+    #: never read inside a kernel, fetched once per run by the MoveLedger
+    #: (analyzer/provenance.py) at the existing span boundaries.
+    touch_tag: jax.Array  # i32[P, R]
+
+
+#: touch-tag packing width: `tag = round * TAG_WAVE_BASE + wave`. apply-wave
+#: budgets are <= 16 everywhere, and rounds <= rounds_ceiling (8192), so the
+#: packed value stays far inside i32.
+TAG_WAVE_BASE = 1024
+
+
+def make_touch_tag(rnd, wave):
+    """i32 scalar: packed (round, wave) provenance tag for an apply site."""
+    return jnp.int32(rnd) * jnp.int32(TAG_WAVE_BASE) + jnp.int32(wave)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -329,6 +346,7 @@ def compute_aggregates(static: StaticCtx, assignment: jax.Array, dims: Dims) -> 
         rack_replica_count=rack_replica_count,
         topic_replica_count=topic_replica_count,
         host_cpu_load=host_cpu,
+        touch_tag=jnp.full((p, r), -1, dtype=jnp.int32),
     )
 
 
@@ -385,6 +403,11 @@ def apply_action(static: StaticCtx, agg: Aggregates, act: ActionBatch, apply_fla
         .at[static.broker_host[dst]]
         .add(dcpu)
     )
+    p_total = agg.assignment.shape[0]
+    pw = jnp.where(w, p, p_total)
+    pl = jnp.where(w & ~is_move, p, p_total)
+    touch = agg.touch_tag.at[pw, slot].set(jnp.int32(-1), mode="drop")
+    touch = touch.at[pl, jnp.zeros_like(slot)].set(jnp.int32(-1), mode="drop")
     return Aggregates(
         assignment=new_assignment,
         broker_load=broker_load,
@@ -395,6 +418,7 @@ def apply_action(static: StaticCtx, agg: Aggregates, act: ActionBatch, apply_fla
         rack_replica_count=rack_counts,
         topic_replica_count=topic_counts,
         host_cpu_load=host_cpu,
+        touch_tag=touch,
     )
 
 
@@ -499,7 +523,8 @@ def rank_paired_destinations(valid_src, dst_key, offset) -> jax.Array:
 
 
 def apply_actions_batch(
-    static: StaticCtx, agg: Aggregates, act: ActionBatch, flags: jax.Array
+    static: StaticCtx, agg: Aggregates, act: ActionBatch, flags: jax.Array,
+    tag=None,
 ) -> Aggregates:
     """Apply a WAVE of actions (1-D fields in `act`, `flags: bool[N]`) at once.
 
@@ -510,6 +535,10 @@ def apply_actions_batch(
     i.e. a batch of reference-legal greedy steps, not an approximation.
     Scatter-adds are duplicate-safe regardless; only the per-action
     *validation* relies on disjointness.
+
+    `tag`: optional i32 scalar provenance tag (`make_touch_tag(rnd, wave)`)
+    scattered into `touch_tag` for exactly the cells this wave writes; it
+    never feeds back into any decision, so results are tag-invariant.
     """
     p_total = agg.assignment.shape[0]
     is_move = act.kind == KIND_MOVE
@@ -564,6 +593,11 @@ def apply_actions_batch(
         .at[static.broker_host[dst]]
         .add(dcpu)
     )
+    # provenance: stamp the tag into exactly the cells written above (the
+    # same routed indices, so masked-out entries drop identically)
+    t = jnp.int32(-1) if tag is None else jnp.int32(tag)
+    touch = agg.touch_tag.at[p_any, slot].set(t, mode="drop")
+    touch = touch.at[p_lead, jnp.zeros_like(slot)].set(t, mode="drop")
     return Aggregates(
         assignment=new_assignment,
         broker_load=broker_load,
@@ -574,6 +608,7 @@ def apply_actions_batch(
         rack_replica_count=rack_counts,
         topic_replica_count=topic_counts,
         host_cpu_load=host_cpu,
+        touch_tag=touch,
     )
 
 

@@ -51,6 +51,7 @@ from cruise_control_tpu.analyzer.context import (
     Aggregates,
     StaticCtx,
     apply_actions_batch,
+    make_touch_tag,
     wave_select,
 )
 
@@ -386,7 +387,6 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
         waves = max(1, apply_waves)
 
         def wave(carry, w):
-            del w
             agg_c, applied_any, blocked = carry
             masked = jnp.where(blocked, -jnp.inf, cells)
             ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
@@ -406,7 +406,9 @@ def make_pair_drain_round(goal, dims, n_pairs: int, apply_waves: int):
                 b_count, dims.num_hosts,
                 parts=(act.p,), num_partitions=p_count,
             )
-            agg_c = apply_actions_batch(static, agg_c, act, w_sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, act, w_sel, tag=make_touch_tag(rnd, w)
+            )
             dead = w_sel | (jnp.isfinite(bs) & ~jnp.isfinite(s_now))
             blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
             # a moved replica is gone: its whole destination row dies
@@ -588,7 +590,6 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
         waves = max(1, apply_waves)
 
         def wave(carry, w):
-            del w
             agg_c, applied_any, blocked = carry
             masked = jnp.where(blocked, -jnp.inf, cells)
             ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
@@ -606,8 +607,12 @@ def make_topic_swap_round(goal, dims, n_pairs: int, d_dst: int, k_ret: int,
                 dst_host2=static.broker_host[pair_b],
                 parts=(p1, p2), num_partitions=p_count,
             )
-            agg_c = apply_actions_batch(static, agg_c, mv1, w_sel)
-            agg_c = apply_actions_batch(static, agg_c, mv2, w_sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, mv1, w_sel, tag=make_touch_tag(rnd, w)
+            )
+            agg_c = apply_actions_batch(
+                static, agg_c, mv2, w_sel, tag=make_touch_tag(rnd, w)
+            )
             dead = w_sel | (jnp.isfinite(bs) & ~ok_w)
             blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
             # an applied row's replica moved: its whole row dies
@@ -833,7 +838,6 @@ def make_leadership_relay_round(goal, dims, n_src: int, k_out: int, k_ret: int,
             return p1, s1_all[i_s1], i2, s1_all[i_s2]
 
         def wave(carry, w):
-            del w
             agg_c, applied_any, blocked = carry
             masked = jnp.where(blocked, -jnp.inf, cells)
             ci = jnp.argmax(masked, axis=1).astype(jnp.int32)
@@ -855,8 +859,12 @@ def make_leadership_relay_round(goal, dims, n_src: int, k_out: int, k_ret: int,
                 parts=(p1, p2), num_partitions=p_count,
                 brokers3=e_i,
             )
-            agg_c = apply_actions_batch(static, agg_c, act1, w_sel)
-            agg_c = apply_actions_batch(static, agg_c, act2, w_sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, act1, w_sel, tag=make_touch_tag(rnd, w)
+            )
+            agg_c = apply_actions_batch(
+                static, agg_c, act2, w_sel, tag=make_touch_tag(rnd, w)
+            )
             dead = w_sel | (jnp.isfinite(bs) & ~ok_w)
             blk = blocked.at[rows0, ci].set(blocked[rows0, ci] | dead)
             # an applied row's leadership moved: its whole row dies
@@ -914,7 +922,9 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
 
     def drain_round(static: StaticCtx, agg: Aggregates, tables, gs, contrib,
                     rnd=None):
-        del rnd  # source ranks are load-valued, not tie-heavy; no rotation
+        # source ranks are load-valued, not tie-heavy; no candidate rotation —
+        # `rnd` only stamps the provenance touch tag on applied waves
+        rnd = jnp.int32(-1) if rnd is None else rnd
         rank = goal.src_rank(static, gs, agg)
         rank = jnp.where(static.dead, jnp.inf, rank)
         _, hot = jax.lax.top_k(rank, v)  # i32[V]
@@ -1045,7 +1055,9 @@ def make_drain_round(goal, dims, n_src: int, k_rep: int, c_dst: int,
                 dims.num_brokers, dims.num_hosts,
                 parts=(all_act.p,), num_partitions=p_count,
             )
-            agg_c = apply_actions_batch(static, agg_c, all_act, sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, all_act, sel, tag=make_touch_tag(rnd, w)
+            )
             sel_mv = sel[:v]
             # A nomination that failed re-scoring is a dead cell; conflict
             # losers stay available for later waves. An applied move's

@@ -73,6 +73,7 @@ from cruise_control_tpu.analyzer.context import (
     compute_aggregates,
     dims_of,
     dst_hosts_partition,
+    make_touch_tag,
     wave_select,
 )
 from cruise_control_tpu.analyzer.acceptance import (
@@ -216,6 +217,15 @@ class OptimizerSettings:
     #: (GoalOptimizer.java:129-179 runs goals once) — this is TPU-side
     #: headroom, and the parity gate only requires not being worse. 0 = off.
     polish_rounds: int = 0
+    #: collect the decision-provenance ledger (analyzer/provenance.py): the
+    #: compiled programs additionally snapshot the assignment + touch-tag
+    #: arrays once per goal phase, and the run's MoveLedger is built from
+    #: the one batched device_get the optimizer already performs. The tag
+    #: stamping in the apply kernels runs regardless (it is result-inert);
+    #: this flag only gates the snapshot buffers and the host-side ledger
+    #: build, so ledger-on and ledger-off runs produce byte-identical
+    #: proposals (tests/test_provenance.py equivalence contract).
+    ledger: bool = True
 
     @classmethod
     def from_config(cls, config) -> "OptimizerSettings":
@@ -237,6 +247,7 @@ class OptimizerSettings:
             bucket_brokers=config.get_boolean("optimizer.bucket.brokers"),
             bucket_ratio=config.get_double("optimizer.bucket.ratio"),
             bucket_floor=config.get_int("optimizer.bucket.floor"),
+            ledger=config.get_boolean("optimizer.provenance.ledger"),
         )
 
 
@@ -330,7 +341,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     k_sel = max(1, min(settings.batch_k, p_count))
     use_leadership = goal.uses_leadership and r >= 2
 
-    def one_round(static: StaticCtx, agg: Aggregates, tables):
+    def one_round(static: StaticCtx, agg: Aggregates, tables, rnd=jnp.int32(0)):
         gs = goal.prepare(static, agg, dims)
 
         # ---- move family: [P, R, K] grid
@@ -395,7 +406,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # next round's grid re-scores everything anyway).
         all_brokers = jnp.arange(dims.num_brokers, dtype=jnp.int32)
 
-        def wave_with_dst(agg_c, applied_any, done, fresh_dst):
+        def wave_with_dst(agg_c, applied_any, done, fresh_dst, wave_idx):
             act = build_selected(
                 static.part_load, agg_c.assignment, sel_p, sel_kind, sel_slot, fresh_dst
             )
@@ -410,7 +421,9 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 score, act.src, act.dst, static.broker_host[act.dst], ok,
                 dims.num_brokers, dims.num_hosts,
             )
-            agg_c = apply_actions_batch(static, agg_c, act, w_sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, act, w_sel, tag=make_touch_tag(rnd, wave_idx)
+            )
             return agg_c, applied_any | jnp.any(w_sel), done | w_sel
 
         def lead_dst(agg_c):
@@ -438,7 +451,9 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                 fresh_dst = jnp.where(sel_kind == KIND_MOVE, paired, lead_dst(agg_c))
             else:
                 fresh_dst = jnp.where(sel_kind == KIND_MOVE, sel_dst0, lead_dst(agg_c))
-            agg_c, applied_any, done = wave_with_dst(agg_c, applied_any, done, fresh_dst)
+            agg_c, applied_any, done = wave_with_dst(
+                agg_c, applied_any, done, fresh_dst, w
+            )
             return (agg_c, applied_any, done), None
 
         if k_sel == 1 and goal.uses_moves:
@@ -476,7 +491,9 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
             s_b = score_batch(static, agg2, candB, goal, gs, tables)
             best = jnp.argmax(s_b, axis=1).astype(jnp.int32)
             fresh_dst = jnp.where(sel_kind == KIND_MOVE, best, lead_dst(agg2))
-            agg2, applied_any, done = wave_with_dst(agg2, applied_any, done, fresh_dst)
+            agg2, applied_any, done = wave_with_dst(
+                agg2, applied_any, done, fresh_dst, jnp.int32(n_waves)
+            )
         return agg2, applied_any
 
     # batched mode runs EVERY goal as a drain/fill round (analyzer.drain):
@@ -655,7 +672,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                         contrib = contrib * round_jitter(contrib.shape[0], rnd)[:, None]
                     agg2, applied = drain_fn(static, agg_in, tables, gs0, contrib, rnd)
                 else:
-                    agg2, applied = one_round(static, agg_in, tables)
+                    agg2, applied = one_round(static, agg_in, tables, rnd)
                 if swap_fn is not None:
                     # swaps only when plain moves stalled, matching the
                     # reference's move-first-then-swap order; `contrib` is
@@ -663,7 +680,7 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
                     agg2, swap_applied = jax.lax.cond(
                         applied,
                         lambda a: (a, jnp.asarray(False)),
-                        lambda a: swap_fn(static, a, tables, contrib),
+                        lambda a: swap_fn(static, a, tables, contrib, rnd),
                         agg2,
                     )
                     applied = applied | swap_applied
@@ -778,6 +795,7 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
     def stack_step(static: StaticCtx, agg: Aggregates):
         tables = empty_tables(dims)
         vb, va, cb, ca, rs, cv, fps = [], [], [], [], [], [], []
+        snaps_a, snaps_t = [], []
         for goal, loop in zip(goals, loops):
             # named_scope: xplane op names carry the goal, so a profiler
             # capture (scripts/parse_xplane.py) joins against the tracer's
@@ -794,6 +812,11 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
                 cv.append(empties >= loop.empties_to_stall)
                 fps.append(_state_fingerprint(agg))
                 tables = goal.contribute_acceptance(static, gs1, tables)
+                if settings.ledger:
+                    # provenance snapshot at the goal-phase boundary: the
+                    # ledger diffs consecutive rows into per-goal moves
+                    snaps_a.append(agg.assignment)
+                    snaps_t.append(agg.touch_tag)
         if settings.polish_rounds > 0:
             # polish pass under the FULL merged tables (see
             # OptimizerSettings.polish_rounds); this traces every goal loop a
@@ -816,6 +839,9 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
                     rs[i] = rs[i] + rounds
                     cv[i] = jnp.where(skip, cv[i], empties >= stall_g)
                     fps[i] = _state_fingerprint(agg)
+                    if settings.ledger:
+                        snaps_a.append(agg.assignment)
+                        snaps_t.append(agg.touch_tag)
             for i, goal in enumerate(goals):
                 gs1 = goal.prepare(static, agg, dims)
                 va[i] = jnp.sum(
@@ -831,7 +857,8 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
             converged=jnp.stack(cv),
             state_fp=jnp.stack(fps),
         )
-        return agg, metrics
+        prov = (jnp.stack(snaps_a), jnp.stack(snaps_t)) if settings.ledger else None
+        return agg, metrics, prov
 
     # the input aggregates are dead after the call (the caller rebinds to the
     # output); donating lets XLA write the final state over them in place
@@ -915,10 +942,10 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
 
     def machine(static: StaticCtx, agg: Aggregates, tables, goal_idx,
                 rounds_in_goal, empties_in_goal, metrics: StackMetrics, budget,
-                enabled):
+                enabled, snap):
         def make_branch(goal, loop):
             def branch(op):
-                agg_b, tables_b, gi, rig, emp, metrics_b, left = op
+                agg_b, tables_b, gi, rig, emp, metrics_b, left, snap_b = op
                 polishing = gi >= n_goals
                 gim = jnp.where(polishing, gi - n_goals, gi)
                 gs_in = goal.prepare(static, agg_b, dims)
@@ -1036,17 +1063,31 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
                 gi2 = jnp.where(done_goal, gi + 1, gi)
                 rig2 = jnp.where(done_goal, jnp.int32(0), rig2)
                 emp2 = jnp.where(done_goal, jnp.int32(0), emp2)
-                return agg2, tables2, gi2, rig2, emp2, metrics_b, left - rounds
+                # provenance snapshot at the phase boundary: written exactly
+                # once per phase (when the goal completes); a ledger-off
+                # program carries zero-length buffers and every write drops
+                snap_a, snap_t = snap_b
+                row = jnp.where(done_goal, gi, jnp.int32(n_phases))
+                snap_b = (
+                    snap_a.at[row].set(agg2.assignment, mode="drop"),
+                    snap_t.at[row].set(agg2.touch_tag, mode="drop"),
+                )
+                return agg2, tables2, gi2, rig2, emp2, metrics_b, left - rounds, snap_b
 
             def skip_branch(op):
                 # disabled goal (runtime subset mask): advance the cursor in
                 # one step — zero rounds, no table contribution, metrics rows
                 # untouched — exactly what a program traced without this goal
                 # would compute
-                agg_b, tables_b, gi, rig, emp, metrics_b, left = op
+                agg_b, tables_b, gi, rig, emp, metrics_b, left, snap_b = op
+                snap_a, snap_t = snap_b
+                snap_b = (
+                    snap_a.at[gi].set(agg_b.assignment, mode="drop"),
+                    snap_t.at[gi].set(agg_b.touch_tag, mode="drop"),
+                )
                 return (
                     agg_b, tables_b, gi + 1, jnp.int32(0), jnp.int32(0),
-                    metrics_b, left,
+                    metrics_b, left, snap_b,
                 )
 
             def named_branch(op):
@@ -1062,27 +1103,43 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
         branches = [make_branch(g, l) for g, l in zip(goals, loops)]
 
         def cond(c):
-            _, _, gi, _, _, _, left = c
+            _, _, gi, _, _, _, left, _ = c
             return (left > 0) & (gi < n_phases)
 
         def body(c):
-            agg_c, tables_c, gi, rig, emp, metrics_c, left = c
+            agg_c, tables_c, gi, rig, emp, metrics_c, left, snap_c = c
             gim = jnp.where(gi >= n_goals, gi - n_goals, gi)
             return jax.lax.switch(
                 jnp.minimum(gim, n_goals - 1), branches,
-                (agg_c, tables_c, gi, rig, emp, metrics_c, left),
+                (agg_c, tables_c, gi, rig, emp, metrics_c, left, snap_c),
             )
 
-        agg2, tables2, gi2, rig2, emp2, metrics2, left2 = jax.lax.while_loop(
+        agg2, tables2, gi2, rig2, emp2, metrics2, left2, snap2 = jax.lax.while_loop(
             cond, body,
-            (agg, tables, goal_idx, rounds_in_goal, empties_in_goal, metrics, budget),
+            (agg, tables, goal_idx, rounds_in_goal, empties_in_goal, metrics,
+             budget, snap),
         )
-        return agg2, tables2, gi2, rig2, emp2, metrics2, budget - left2
+        return agg2, tables2, gi2, rig2, emp2, metrics2, budget - left2, snap2
 
     # donate the buffers the chunked driver threads through repeated calls
-    # (agg / tables / metrics): XLA reuses their device memory for the
-    # outputs instead of copying the big arrays every chunk
-    return jax.jit(machine, donate_argnums=(1, 2, 6))
+    # (agg / tables / metrics / provenance snapshots): XLA reuses their
+    # device memory for the outputs instead of copying the big arrays every
+    # chunk
+    return jax.jit(machine, donate_argnums=(1, 2, 6, 9))
+
+
+def empty_prov_snapshots(n_phases: int, dims: Dims, enabled: bool):
+    """Per-phase provenance snapshot buffers for the goal machine: one
+    (assignment, touch_tag) row per phase. Ledger-off programs carry
+    ZERO-LENGTH buffers: every in-kernel `.at[row].set(..., mode='drop')`
+    then drops, so the two modes share identical math — only the snapshot
+    copies differ."""
+    n = n_phases if enabled else 0
+    shape = (n, dims.num_partitions, dims.max_rf)
+    return (
+        jnp.zeros(shape, dtype=jnp.int32),
+        jnp.full(shape, -1, dtype=jnp.int32),
+    )
 
 
 def empty_stack_metrics(n_goals: int) -> StackMetrics:
@@ -1272,12 +1329,14 @@ def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
         f"chunked goal machine ({len(goal_names)} goals"
         + (", mesh)" if mesh is not None else ")")
     )
+    n_phases = 2 * len(goal_names) if settings.polish_rounds > 0 else len(goal_names)
     return _compile_cached(
         key, tag, dims,
         lambda: _cached_goal_machine(goal_names, dims, settings).lower(
             static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
             empty_stack_metrics(len(goal_names)), jnp.int32(1),
             jnp.ones((len(goal_names),), dtype=bool),
+            empty_prov_snapshots(n_phases, dims, settings.ledger),
         ),
     )
 
@@ -1345,6 +1404,11 @@ class OptimizerResult:
     #: during dispatch. None when the result was computed on a caller model.
     generation: Optional[int] = None
     fingerprint: Optional[object] = None
+    #: decision-provenance ledger of this run (analyzer/provenance.py
+    #: RunLedger): per-move goal/engine/round attribution, also registered in
+    #: the process MoveLedger for GET /explain. None when the optimizer ran
+    #: with `optimizer.provenance.ledger` off (or returned before running).
+    provenance: Optional[object] = None
 
     @property
     def violated_goals_before(self) -> List[str]:
@@ -1364,8 +1428,15 @@ class OptimizerResult:
                     self.fingerprint.to_dict() if self.fingerprint is not None else None
                 ),
             }
+        prov = None
+        if self.provenance is not None:
+            prov = {
+                "runId": self.provenance.run_id,
+                "digest": self.provenance.digest(),
+            }
         return {
             **({"proposalStamp": stamp} if stamp else {}),
+            **({"provenance": prov} if prov else {}),
             "numReplicaMovements": self.num_replica_moves,
             "numLeaderMovements": self.num_leadership_moves,
             "dataToMoveMB": round(self.data_to_move_mb, 3),
@@ -1439,19 +1510,21 @@ class GoalOptimizer:
         tables = _empty(dims)
         metrics = empty_stack_metrics(len(goal_names))
         enabled_dev = jnp.asarray(enabled, dtype=bool)
+        n = len(goal_names)
+        # polish pass (see _make_goal_machine): phases n..2n-1 re-run each
+        # goal under the full merged tables
+        n_phases = 2 * n if self._settings.polish_rounds > 0 else n
+        snap = empty_prov_snapshots(n_phases, dims, self._settings.ledger)
         if self._mesh is not None:
             from cruise_control_tpu.parallel.sharding import place_replicated
 
             tables = place_replicated(tables, self._mesh)
             metrics = place_replicated(metrics, self._mesh)
             enabled_dev = place_replicated(enabled_dev, self._mesh)
+            snap = place_replicated(snap, self._mesh)
         machine = _machine_executable(
             goal_names, dims, self._settings, self._mesh, static, agg, tables
         )
-        n = len(goal_names)
-        # polish pass (see _make_goal_machine): phases n..2n-1 re-run each
-        # goal under the full merged tables
-        n_phases = 2 * n if self._settings.polish_rounds > 0 else n
         gi = jnp.int32(0)
         rig = jnp.int32(0)
         emp = jnp.int32(0)
@@ -1475,9 +1548,9 @@ class GoalOptimizer:
                 phase="polish" if gi_entry >= n else "main",
                 budget=int(max(1, chunk)),
             ) as call_span, jax.profiler.TraceAnnotation("cc-machine-call"):
-                agg, tables, gi, rig, emp, metrics, spent = machine(
+                agg, tables, gi, rig, emp, metrics, spent, snap = machine(
                     static, agg, tables, gi, rig, emp, metrics,
-                    jnp.int32(max(1, chunk)), enabled_dev,
+                    jnp.int32(max(1, chunk)), enabled_dev, snap,
                 )
                 gi_h, spent_h, rounds_h = jax.device_get((gi, spent, metrics.rounds))
                 call_span.attributes["rounds"] = int(spent_h)
@@ -1516,8 +1589,10 @@ class GoalOptimizer:
         if self._settings.polish_rounds > 0:
             viol, cost = _cached_measure(goal_names, dims)(static, agg)
             metrics = metrics._replace(violated_after=viol, cost_after=cost)
-        metrics = jax.device_get(metrics)
-        return agg, metrics, time.monotonic() - t_stack, durs
+        # ONE batched transfer for metrics + the provenance snapshot stack
+        # (the chunked driver's span boundary): no per-move host sync exists
+        metrics, snap = jax.device_get((metrics, snap))
+        return agg, metrics, time.monotonic() - t_stack, durs, snap
 
     def _prepare(
         self,
@@ -1729,10 +1804,16 @@ class GoalOptimizer:
             machine = _machine_executable(
                 machine_names, dims, self._settings, self._mesh, static, agg, tables
             )
+            n_ph = (
+                2 * len(machine_names)
+                if self._settings.polish_rounds > 0
+                else len(machine_names)
+            )
             out = machine(
                 static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
                 empty_stack_metrics(len(machine_names)), jnp.int32(1),
                 enabled_dev,
+                empty_prov_snapshots(n_ph, dims, self._settings.ledger),
             )
             jax.block_until_ready(out[6])
             if self._settings.polish_rounds > 0:
@@ -1746,7 +1827,7 @@ class GoalOptimizer:
             step = _stack_executable(
                 goal_names_t, dims, self._settings, self._mesh, static, agg
             )
-            _, metrics = step(static, agg)
+            _, metrics, _prov = step(static, agg)
             jax.block_until_ready(metrics)
         return time.monotonic() - t0
 
@@ -1825,14 +1906,23 @@ class GoalOptimizer:
 
         goal_names_t = tuple(g.name for g in goals)
         goal_durs: Optional[np.ndarray] = None
+        #: provenance collection state: the phase-ordered goal list the
+        #: snapshot rows are indexed by, the full (un-row-selected) metrics,
+        #: the runtime enabled mask, and the host snapshot arrays
+        ledger_names: Tuple[str, ...] = goal_names_t
+        ledger_enabled = None
+        metrics_full = None
+        prov = None
         if self._settings.chunk_rounds > 0:
             machine_names, enabled, rows = _machine_goal_plan(goal_names_t)
-            agg, metrics, stack_s, goal_durs = self._run_chunked(
+            agg, metrics_full, stack_s, goal_durs, prov = self._run_chunked(
                 machine_names, enabled, dims, static, agg
             )
+            ledger_names = machine_names
+            ledger_enabled = enabled
             # machine metrics are rowed by the (full) machine goal list;
             # select the requested goals' rows back out
-            metrics = StackMetrics(*(np.asarray(a)[rows] for a in metrics))
+            metrics = StackMetrics(*(np.asarray(a)[rows] for a in metrics_full))
             goal_durs = goal_durs[rows]
         else:
             step = _stack_executable(
@@ -1843,7 +1933,7 @@ class GoalOptimizer:
                 "optimizer.stack-call", kind="device-call",
                 goal="<fused-stack>", phase="main",
             ), jax.profiler.TraceAnnotation("cc-stack-call"):
-                agg, metrics = step(static, agg)
+                agg, metrics, prov = step(static, agg)
                 jax.block_until_ready(metrics)
             stack_s = time.monotonic() - t_stack
             REGISTRY.meter("GoalOptimizer.device-dispatches").mark()
@@ -1852,14 +1942,19 @@ class GoalOptimizer:
         final_model = model._replace(assignment=agg.assignment)
         stats_after = _jit_compute_stats(final_model, dims.num_topics)
 
-        # ONE host transfer for everything the result needs (the device sync
-        # point of the whole run).
-        metrics, stats_before, stats_after, init_np, final_np = jax.device_get(
-            (metrics, stats_before, stats_after, init_assignment, agg.assignment)
+        # ONE host transfer for everything the result needs — including the
+        # provenance snapshot stack (the device sync point of the whole run;
+        # chunked mode already fetched its snapshots at the driver boundary).
+        metrics, stats_before, stats_after, init_np, final_np, prov = jax.device_get(
+            (metrics, stats_before, stats_after, init_assignment, agg.assignment,
+             prov)
         )
+        if metrics_full is None:
+            metrics_full = metrics
         TELEMETRY.record_transfer(
             "d2h",
-            tree_nbytes((metrics, stats_before, stats_after, init_np, final_np)),
+            tree_nbytes((metrics, stats_before, stats_after, init_np, final_np,
+                         prov)),
         )
         if goal_durs is None:
             # fused mode: per-round latency is only observable as the stack
@@ -1917,7 +2012,8 @@ class GoalOptimizer:
         # drop mesh-padding rows: pad rows never change, so proposals/stats are
         # unaffected and the returned assignment round-trips with the caller's
         # unpadded part_load.
-        init_np = np.asarray(init_np)[:p_orig]
+        init_full = np.asarray(init_np)
+        init_np = init_full[:p_orig]
         final_np = np.asarray(final_np)[:p_orig]
         proposals = proposal_diff(init_np, final_np, np.asarray(model.part_load)[:p_orig])
         n_moves = sum(len(pr.replicas_to_add) for pr in proposals)
@@ -1927,6 +2023,10 @@ class GoalOptimizer:
             if pr.new_leader != pr.old_leader and not pr.replicas_to_add
         )
         data_mb = sum(pr.data_to_move_mb for pr in proposals)
+        provenance = self._build_ledger(
+            ledger_names, ledger_enabled, metrics_full, prov, init_full,
+            p_orig, dims, bucketed, len(proposals),
+        )
         wall = time.monotonic() - t0
         # hot timers are histograms: /metrics serves their p50/p95/p99
         REGISTRY.histogram("GoalOptimizer.proposal-computation-timer").record(wall)
@@ -1942,4 +2042,74 @@ class GoalOptimizer:
             data_to_move_mb=float(data_mb),
             duration_s=wall,
             bucketed=bucketed,
+            provenance=provenance,
         )
+
+    def _build_ledger(self, ledger_names, enabled, metrics_full, prov,
+                      init_assignment, p_orig: int, dims: Dims, bucketed,
+                      num_proposals: int):
+        """Diff the per-phase snapshots into this run's RunLedger and record
+        it in the process MoveLedger (analyzer/provenance.py). Host-side
+        numpy over the already-fetched arrays — no extra device sync."""
+        if prov is None or prov[0].shape[0] == 0:
+            return None
+        from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
+        from cruise_control_tpu.analyzer.provenance import (
+            LEDGER,
+            build_run_ledger,
+            new_run_id,
+        )
+
+        g = len(ledger_names)
+        n_phases = prov[0].shape[0]
+        m = metrics_full
+        phases = []
+        for i in range(n_phases):
+            gi = i % g
+            goal_obj = GOAL_REGISTRY[ledger_names[gi]]
+            phases.append({
+                "goal": ledger_names[gi],
+                "engine": goal_engine(goal_obj, dims, self._settings),
+                "phase": "main" if i < g else "polish",
+                "costBefore": float(m.cost_before[gi]),
+                "costAfter": float(m.cost_after[gi]),
+                "violatedBefore": int(m.violated_before[gi]),
+                "violatedAfter": int(m.violated_after[gi]),
+                "rounds": int(m.rounds[gi]),
+                "converged": bool(m.converged[gi]),
+            })
+        run_id = new_run_id()
+        with TRACER.span(
+            "provenance-collect", kind="provenance", runId=run_id,
+        ) as span:
+            ledger = build_run_ledger(
+                run_id, phases, init_assignment, prov[0], prov[1],
+                valid_partitions=p_orig,
+                meta={
+                    "bucket": (bucketed or {}).get("bucket"),
+                    "numProposals": num_proposals,
+                    "goals": list(ledger_names),
+                },
+            )
+            if enabled is not None:
+                # runtime-disabled machine phases contribute no moves: drop
+                # their zero segments and renumber the kept phases so the
+                # ledger's goal_index matches the REQUESTED stack order —
+                # a chunked-machine run (full-stack program + subset mask)
+                # and a fused-stack run of the same request then produce
+                # decision-identical ledgers (diff_runs/digest contract)
+                keep = [i for i in range(n_phases) if bool(enabled[i % g])]
+                index_map = {old: new for new, old in enumerate(keep)}
+                ledger.segments = [
+                    dataclasses.replace(s, index=index_map[s.index])
+                    for s in ledger.segments
+                    if s.index in index_map
+                ]
+                ledger.moves = [
+                    m._replace(goal_index=index_map[m.goal_index])
+                    for m in ledger.moves
+                    if m.goal_index in index_map
+                ]
+            span.attributes["moves"] = len(ledger.moves)
+            LEDGER.record(ledger)
+        return ledger

@@ -38,6 +38,7 @@ from cruise_control_tpu.analyzer.context import (
     Aggregates,
     StaticCtx,
     apply_actions_batch,
+    make_touch_tag,
     wave_select,
 )
 from cruise_control_tpu.analyzer.goals.base import SCORE_EPS
@@ -72,7 +73,8 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
     k = max(1, min(k, p_count))
     del priors  # prior-goal invariants arrive via the merged tables
 
-    def swap_round(static: StaticCtx, agg: Aggregates, tables, contrib_in):
+    def swap_round(static: StaticCtx, agg: Aggregates, tables, contrib_in,
+                   rnd=jnp.int32(-1)):
         from cruise_control_tpu.analyzer.drain import heavy_picks, light_picks
 
         gs = goal.prepare(static, agg, dims)
@@ -288,8 +290,12 @@ def make_swap_round(goal, priors, dims, n_pairs: int = 8, k: int = 8,
             # mv1v/mv2v from the validation step are exact here too: applying
             # mv1 can't change p2's row (the grid mask excludes p1 == p2), so
             # mv2's deltas are unchanged
-            agg_c = apply_actions_batch(static, agg_c, mv1v, sel)
-            agg_c = apply_actions_batch(static, agg_c, mv2v, sel)
+            agg_c = apply_actions_batch(
+                static, agg_c, mv1v, sel, tag=make_touch_tag(rnd, w)
+            )
+            agg_c = apply_actions_batch(
+                static, agg_c, mv2v, sel, tag=make_touch_tag(rnd, w)
+            )
             # applied or stale-invalid nominations are dead cells; conflict
             # losers stay available for the next wave
             dead = sel | (jnp.isfinite(bs) & ~valid)

@@ -405,6 +405,16 @@ def _config_def() -> ConfigDef:
              "Collect device telemetry (per-program XLA cost analysis, device memory "
              "watermarks, host-device transfer meters) into the sensor registry and "
              "GET /perf; disable to shave the (already <2%) collection overhead.")
+    d.define("optimizer.provenance.ledger", Type.BOOLEAN, True, None, Importance.LOW,
+             "Collect the decision-provenance MoveLedger: compiled programs snapshot "
+             "the assignment + attribution tags once per goal phase, and every run's "
+             "per-move goal/engine/round attribution becomes queryable via "
+             "GET /explain and scripts/diff_runs.py. Disabling removes the snapshot "
+             "buffers from the compiled programs (recompile on toggle); proposals "
+             "are byte-identical either way.")
+    d.define("observability.ledger.runs", Type.INT, 8, at_least(1), Importance.LOW,
+             "Recorded optimization runs retained by the provenance MoveLedger "
+             "(GET /explain's query window); oldest runs evict first.")
     return d
 
 

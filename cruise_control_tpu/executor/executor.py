@@ -263,13 +263,19 @@ class Executor:
         demoted_brokers: Optional[Set[int]] = None,
         generation: Optional[int] = None,
         fingerprint: Optional[TopologyFingerprint] = None,
+        provenance_run: Optional[str] = None,
     ) -> Dict:
         """Synchronous execution loop; the async layer wraps this in an
         OperationFuture thread. Returns the execution summary.
 
         `generation`/`fingerprint` are the batch's model-build stamps (the
         facade fills them from the OptimizerResult); when given, admission
-        and every batch boundary revalidate against them."""
+        and every batch boundary revalidate against them. `provenance_run`
+        is the MoveLedger run id the proposals were computed under
+        (OptimizerResult.provenance): every task carries its proposal's
+        provenance id into terminal events and drift-trim records, so a
+        failed or trimmed task joins back to the decision that proposed it
+        (GET /explain)."""
         from cruise_control_tpu.common.oplog import op_log as _op_log
 
         with self._lock:
@@ -311,10 +317,12 @@ class Executor:
             try:
                 self._manager.tracker.reset()  # summaries are per execution
                 self._planner.clear()
+                self._provenance_run = provenance_run
                 try:
                     admitted = self._admit_proposals(proposals, generation, fingerprint)
                     self._planner.add_execution_proposals(
-                        admitted, strategy=strategy, urp=urp
+                        admitted, strategy=strategy, urp=urp,
+                        provenance_run=provenance_run,
                     )
                     if not self._validation.get("aborted"):
                         self._run_replica_movements()
@@ -407,11 +415,14 @@ class Executor:
         v["trimmedByReason"][reason] = v["trimmedByReason"].get(reason, 0) + 1
         if len(v["trimmed"]) < 200:  # failures are never truncated silently:
             # numTrimmed/trimmedByReason always carry the full tally
+            run = getattr(self, "_provenance_run", None)
             v["trimmed"].append({
                 "partition": proposal.partition,
                 "topicPartition": proposal.topic_partition,
                 "reason": reason,
                 "phase": phase,
+                # GET /explain join key ("" when the batch carried no ledger)
+                "provenanceId": f"{run}/p{proposal.partition}" if run else "",
             })
 
     def _trim_task(self, task: ExecutionTask, reason: str, now_ms: int) -> None:
@@ -522,6 +533,7 @@ class Executor:
 
         self._validation = v = {
             "enabled": bool(self._config.proposal_revalidate),
+            "provenanceRun": getattr(self, "_provenance_run", None),
             "generationAtBuild": generation,
             "generationAtStart": None,
             "generationSkew": None,
@@ -683,6 +695,7 @@ class Executor:
             "startTimeMs": task.start_time_ms,
             "endTimeMs": task.end_time_ms,
             "reason": task.terminal_reason,
+            "provenanceId": task.provenance_id,
         }
         self._notifier(f"task_{state}", info)
         if task.state != TaskState.COMPLETED:

@@ -39,10 +39,17 @@ class ExecutionTaskPlanner:
         current_assignment=None,
         strategy: Optional[ReplicaMovementStrategy] = None,
         urp: Optional[Set[int]] = None,
+        provenance_run: Optional[str] = None,
     ) -> None:
         """Register proposals, dropping no-ops against `current_assignment`
         (a dict partition -> tuple of current replicas, or None to trust the
-        proposals' old state)."""
+        proposals' old state). `provenance_run` is the MoveLedger run id the
+        batch was computed under; each task is stamped with its proposal's
+        provenance id (`<run>/p<partition>`) so terminal events and drift
+        trims join back to GET /explain."""
+        def pid(p: ExecutionProposal) -> str:
+            return f"{provenance_run}/p{p.partition}" if provenance_run else ""
+
         for p in proposals:
             current = (
                 tuple(current_assignment[p.partition])
@@ -51,11 +58,17 @@ class ExecutionTaskPlanner:
             )
             if p.has_replica_action and not p.is_completed(current):
                 self._remaining_moves.append(
-                    ExecutionTask(self._next_id(), p, TaskType.INTER_BROKER_REPLICA_ACTION)
+                    ExecutionTask(
+                        self._next_id(), p, TaskType.INTER_BROKER_REPLICA_ACTION,
+                        provenance_id=pid(p),
+                    )
                 )
             elif p.has_leader_action and (not current or current[0] != p.new_leader):
                 self._remaining_leaderships.append(
-                    ExecutionTask(self._next_id(), p, TaskType.LEADER_ACTION)
+                    ExecutionTask(
+                        self._next_id(), p, TaskType.LEADER_ACTION,
+                        provenance_id=pid(p),
+                    )
                 )
         use = strategy or self._strategy
         self._remaining_moves = use.apply(self._remaining_moves, urp)

@@ -59,6 +59,12 @@ class ExecutionTask:
     #: failure: ...", "driver unreachable", ...) — failure attribution for
     #: the execution summary and op_log
     terminal_reason: str = ""
+    #: decision-provenance join key (`<ledger run id>/p<partition>`): which
+    #: recorded optimization decision this task executes — carried into
+    #: terminal events and drift-trim records so GET /explain answers both
+    #: "why was this proposed" and "what happened to it". Empty when the
+    #: batch had no recorded ledger.
+    provenance_id: str = ""
     #: invoked once, with the task, when it enters a terminal state; the
     #: executor wires this to its ExecutorNotifier + tracker
     listener: Optional[Callable[["ExecutionTask"], None]] = dataclasses.field(

@@ -45,6 +45,9 @@ class ExecutionTaskTracker:
                     "startTimeMs": task.start_time_ms,
                     "endTimeMs": task.end_time_ms,
                     "reason": task.terminal_reason,
+                    # GET /explain join key (empty when the batch carried no
+                    # recorded decision ledger)
+                    "provenanceId": task.provenance_id,
                 })
 
     def terminal_events(self, only_failures: bool = False) -> List[Dict]:

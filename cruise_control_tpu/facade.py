@@ -134,11 +134,16 @@ class CruiseControl:
         return result
 
     def _execute_result(self, result: OptimizerResult, **kwargs) -> Dict:
-        """Dispatch an optimizer result with its drift stamps attached."""
+        """Dispatch an optimizer result with its drift stamps and decision
+        provenance attached (tasks carry `<run>/p<partition>` ids into
+        terminal events and trim records — GET /explain's execution join)."""
         return self._executor.execute_proposals(
             result.proposals,
             generation=result.generation,
             fingerprint=result.fingerprint,
+            provenance_run=(
+                result.provenance.run_id if result.provenance is not None else None
+            ),
             **kwargs,
         )
 

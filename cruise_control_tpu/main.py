@@ -134,6 +134,12 @@ def build_simulated_service(
             interval_s=cfg.get_double("observability.history.interval.s"),
         )
         TELEMETRY.configure(enabled=cfg.get_boolean("telemetry.enabled"))
+        # decision provenance: how many recorded runs GET /explain can query
+        # (the ledger itself is the optimizer.provenance.ledger key above,
+        # wired through OptimizerSettings.from_config)
+        from cruise_control_tpu.analyzer.provenance import LEDGER
+
+        LEDGER.configure(max_runs=cfg.get_int("observability.ledger.runs"))
     executor = Executor(
         SimulatorClusterDriver(sim, latency_polls=2),
         config=executor_config, load_monitor=monitor,

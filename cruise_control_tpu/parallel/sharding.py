@@ -203,7 +203,7 @@ def place_static(static: StaticCtx, mesh: Mesh) -> StaticCtx:
 
 def place_aggregates(agg: Aggregates, mesh: Mesh) -> Aggregates:
     """Annotate Aggregates: per-partition arrays sharded, summaries replicated."""
-    sharded_fields = {"assignment", "rack_replica_count"}
+    sharded_fields = {"assignment", "rack_replica_count", "touch_tag"}
 
     def place(name, x):
         arr = jax.numpy.asarray(x)

@@ -204,6 +204,13 @@ def optimization_result_response(result, load_before: Optional[BrokerStats],
             "onDemandBalancednessScoreBefore": stats_to_dict(result.stats_before),
             "onDemandBalancednessScoreAfter": stats_to_dict(result.stats_after),
             "durationS": round(result.duration_s, 4),
+            # GET /explain join key: every proposal in this response is
+            # answerable as /explain?run=<id>&partition=<p>
+            **(
+                {"provenanceRun": result.provenance.run_id}
+                if getattr(result, "provenance", None) is not None
+                else {}
+            ),
         },
         "goalSummary": goal_summaries,
         "proposals": [p.to_dict() for p in result.proposals[:max_proposals]],

@@ -6,9 +6,9 @@ cc/servlet/EndPoint.java:38-57:
 
   GET  state, load, partition_load, proposals, kafka_cluster_state,
        user_tasks, review_board, bootstrap, train,
-       metrics, trace, timeseries, perf
+       metrics, trace, timeseries, perf, explain
        (TPU-native observability; also at root /metrics, /trace,
-        /timeseries and /perf — docs/OBSERVABILITY.md)
+        /timeseries, /perf and /explain — docs/OBSERVABILITY.md)
   POST rebalance, add_broker, remove_broker, demote_broker,
        stop_proposal_execution, pause_sampling, resume_sampling,
        topic_configuration, admin, review
@@ -456,6 +456,72 @@ class CruiseControlApp:
                 }
             )
 
+    async def explain(self, request) -> web.Response:
+        """Decision provenance (docs/OBSERVABILITY.md): which goal/engine
+        proposed each accepted move of a recorded optimization run, in which
+        round and apply wave, under what cost/violated deltas — the
+        per-move attribution ledger (`analyzer/provenance.py`). `run`
+        selects a recorded run id (default: the latest); `partition`,
+        `broker`, `goal`, `round`, `kind` (move/leadership), `phase`
+        (main/polish) filter the move list; `view=proposal` groups moves by
+        partition (the 'why is partition p in this proposal' view);
+        `limit` bounds the rows returned."""
+        from cruise_control_tpu.analyzer.provenance import LEDGER
+        from cruise_control_tpu.common.tracing import TRACER
+
+        with TRACER.span("GET /explain", kind="explain"):
+            run_id = request.query.get("run")
+            ledger = LEDGER.get(run_id) if run_id else LEDGER.latest()
+            if ledger is None:
+                msg = (
+                    f"unknown run {run_id!r}" if run_id
+                    else "no optimization run recorded yet"
+                )
+                return self._json(
+                    {"errorMessage": msg, "ledger": LEDGER.state()}, status=404
+                )
+            try:
+                partition = request.query.get("partition")
+                partition = int(partition) if partition is not None else None
+                broker = request.query.get("broker")
+                broker = int(broker) if broker is not None else None
+                rnd = request.query.get("round")
+                rnd = int(rnd) if rnd is not None else None
+                limit = int(request.query.get("limit", "1000"))
+            except ValueError:
+                return self._json(
+                    {"errorMessage": "partition/broker/round/limit must be integers"},
+                    status=400,
+                )
+            view = request.query.get("view", "move")
+            if view not in ("move", "proposal"):
+                return self._json(
+                    {"errorMessage": f"unknown view {view!r} (move|proposal)"},
+                    status=400,
+                )
+            out = {
+                "run": ledger.summary(),
+                "view": view,
+                "ledger": LEDGER.state(),
+                "version": 1,
+            }
+            if view == "proposal":
+                proposals = ledger.proposal_view(partition)
+                out["proposals"] = proposals[: max(0, limit)]
+            else:
+                out["moves"] = [
+                    m.to_dict()
+                    for m in ledger.query(
+                        partition=partition, broker=broker,
+                        goal=request.query.get("goal") or None,
+                        round=rnd,
+                        kind=request.query.get("kind") or None,
+                        phase=request.query.get("phase") or None,
+                        limit=max(0, limit),
+                    )
+                ]
+            return self._json(out)
+
     async def perf(self, request) -> web.Response:
         """The perf observatory join (docs/OBSERVABILITY.md): per-bucket
         compiled-program telemetry (flops/bytes accessed from XLA cost
@@ -685,6 +751,7 @@ class CruiseControlApp:
             ("bootstrap", self.bootstrap), ("train", self.train),
             ("metrics", self.metrics), ("trace", self.trace),
             ("timeseries", self.timeseries), ("perf", self.perf),
+            ("explain", self.explain),
         ]
         p = [
             ("rebalance", self.rebalance), ("add_broker", self.add_broker),
@@ -704,6 +771,7 @@ class CruiseControlApp:
         app.router.add_get("/trace", self.trace)
         app.router.add_get("/timeseries", self.timeseries)
         app.router.add_get("/perf", self.perf)
+        app.router.add_get("/explain", self.explain)
         if self._webui_dir:
             import os
 

@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# One CI entrypoint: cclint -> tier-1 tests -> perf gate.
+#
+# Usage:
+#   scripts/ci.sh [CANDIDATE_BENCH_DETAIL.json]
+#
+# The perf gate only runs when a candidate BENCH_DETAIL.json is given (a
+# fresh bench run is minutes of wall-clock; CI stages it separately and
+# passes the artifact in). The gate diffs it against the committed
+# BENCH_DETAIL.json baseline.
+#
+# Stable exit codes (documented in README; pipelines may match on them):
+#   0  all stages passed
+#   1  cclint findings (or lint usage error)
+#   2  tier-1 test failure
+#   3  perf regression           (perf_gate exit 1)
+#   4  platform mismatch         (perf_gate exit 4)
+#   5  provenance digest mismatch at equal parity — decision drift; run
+#      scripts/diff_runs.py on the two runs' ledgers (perf_gate exit 5)
+#   6  perf-gate usage / unreadable input (perf_gate exit 2)
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== cclint =="
+python scripts/cclint.py || exit 1
+
+echo "== tier-1 tests =="
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider || exit 2
+
+if [ $# -ge 1 ]; then
+    echo "== perf gate =="
+    python scripts/perf_gate.py BENCH_DETAIL.json "$1"
+    rc=$?
+    case $rc in
+        0) ;;
+        1) exit 3 ;;
+        4) exit 4 ;;
+        5) exit 5 ;;
+        *) exit 6 ;;
+    esac
+fi
+
+echo "ci: all stages passed"

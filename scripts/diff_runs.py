@@ -1,0 +1,144 @@
+"""Align two recorded provenance ledgers and pinpoint the first divergent move.
+
+The decision-level counterpart of scripts/perf_gate.py: where perf_gate
+diffs *outcomes* (wall, rounds, parity) between two bench runs, this diffs
+the *decisions* — the per-move attribution ledgers two runs recorded
+(analyzer/provenance.py RunLedger JSON, written by `bench.py` under
+`BENCH_LEDGER_DIR` or dumped via GET /explain) — and reports the FIRST
+move where they disagree, with both sides' full attribution (goal, engine,
+phase, round, wave, src→dst). This is the tool that turns "config 3's
+parity knife-edges by Δ0.193 on NW-in" from prose into a pinpointed
+decision (BASELINE.md round-10 note).
+
+Usage:
+  python scripts/diff_runs.py LEDGER_A.json LEDGER_B.json [--json] [--moves N]
+
+Inputs may be either a bare RunLedger dict or a file with a top-level
+{"ledger": {...}} wrapper. Exit codes (stable):
+  0  ledgers are decision-identical (same canonical move list)
+  1  diverged (first divergence reported)
+  2  usage / unreadable input
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as `python scripts/diff_runs.py` from anywhere: the ledger model
+# lives in the package, which sits next to this script's parent dir
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXIT_IDENTICAL = 0
+EXIT_DIVERGED = 1
+EXIT_ERROR = 2
+
+
+def _load(path: str):
+    from cruise_control_tpu.analyzer.provenance import RunLedger
+
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"diff_runs: cannot read {path}: {e}", file=sys.stderr)
+        raise SystemExit(EXIT_ERROR)
+    if isinstance(doc, dict) and "ledger" in doc:
+        doc = doc["ledger"]
+    if not isinstance(doc, dict) or "segments" not in doc:
+        print(
+            f"diff_runs: {path} is not a RunLedger dump "
+            "(expected 'segments'/'moves' keys)",
+            file=sys.stderr,
+        )
+        raise SystemExit(EXIT_ERROR)
+    return RunLedger.from_dict(doc)
+
+
+def _fmt_move(m: dict | None) -> str:
+    if m is None:
+        return "(no move — this side's stream ended here)"
+    return (
+        f"p{m['partition']}[slot {m['slot']}] {m['kind']} "
+        f"{m['src']}->{m['dst']}  goal={m['goal']} engine={m['engine']} "
+        f"phase={m['phase']} round={m['round']} wave={m['wave']}"
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="report the first divergent move between two recorded ledgers"
+    )
+    parser.add_argument("ledger_a", help="RunLedger JSON (e.g. the batched run)")
+    parser.add_argument("ledger_b", help="RunLedger JSON (e.g. the greedy baseline)")
+    parser.add_argument("--json", action="store_true", help="machine output")
+    parser.add_argument("--moves", type=int, default=5,
+                        help="context moves to print around the divergence")
+    args = parser.parse_args(argv)
+
+    from cruise_control_tpu.analyzer.provenance import MoveRecord, diff_ledgers
+
+    a = _load(args.ledger_a)
+    b = _load(args.ledger_b)
+    report = diff_ledgers(a, b)
+
+    if args.json:
+        print(json.dumps(report, indent=1))
+        return EXIT_IDENTICAL if report["identical"] else EXIT_DIVERGED
+
+    print(f"run A: {report['runA']}  ({report['movesA']} moves, "
+          f"checksum {report['digestA']['checksum']})")
+    print(f"run B: {report['runB']}  ({report['movesB']} moves, "
+          f"checksum {report['digestB']['checksum']})")
+    print("\n== per-goal decision deltas (A - B) ==")
+    header = (
+        f"{'goal':<38} {'phase':<7} {'movesA':>7} {'movesB':>7} "
+        f"{'costAfterA':>11} {'costAfterB':>11} {'delta':>10}"
+    )
+    print(header)
+    print("-" * len(header))
+    for s in report["segments"]:
+        marker = "!!" if abs(s["costAfterDelta"]) > 1e-9 or s["movesA"] != s["movesB"] else "  "
+        print(
+            f"{marker}{s['goal']:<36} {s['phase']:<7} {s['movesA']:>7} "
+            f"{s['movesB']:>7} {s['costAfterA']:>11.4f} {s['costAfterB']:>11.4f} "
+            f"{s['costAfterDelta']:>+10.4f}"
+        )
+
+    if report["identical"]:
+        print("\nledgers are decision-identical (same canonical move list)")
+        return EXIT_IDENTICAL
+
+    fd = report["firstDivergence"]
+    print(
+        f"\n== FIRST DIVERGENT MOVE (canonical index {fd['index']}; "
+        f"goal {report['firstDivergenceGoal']}, "
+        f"phase {report['firstDivergencePhase']}) =="
+    )
+    print(f"  A: {_fmt_move(fd['a'])}")
+    print(f"  B: {_fmt_move(fd['b'])}")
+    if args.moves > 0:
+        sa = sorted(a.moves, key=MoveRecord.key)
+        sb = sorted(b.moves, key=MoveRecord.key)
+        i0 = max(0, fd["index"] - args.moves)
+        i1 = fd["index"] + args.moves + 1
+        print(f"\n  context (canonical order, moves {i0}..{i1 - 1}):")
+
+        def _decision(d):
+            # engine labels are presentation, not decisions (MoveRecord.decision)
+            return {k: v for k, v in d.items() if k != "engine"} if d else None
+
+        for i in range(i0, min(i1, max(len(sa), len(sb)))):
+            ma = sa[i].to_dict() if i < len(sa) else None
+            mb = sb[i].to_dict() if i < len(sb) else None
+            same = _decision(ma) == _decision(mb)
+            print(f"  {' ' if same else '>'} [{i:>5}] A {_fmt_move(ma)}")
+            if not same:
+                print(f"    [{i:>5}] B {_fmt_move(mb)}")
+    return EXIT_DIVERGED
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,7 +19,10 @@ Output (stdout):
   6. the perf observatory: device telemetry (per-bucket program flops/bytes
      from XLA cost analysis, device-memory watermark, host<->device transfer
      totals) and the top time-series movers from /timeseries
-     (docs/OBSERVABILITY.md telemetry section).
+     (docs/OBSERVABILITY.md telemetry section),
+  7. decision provenance from /explain: the latest recorded run's moves by
+     goal/engine, its top cost-delta movers, and the MoveLedger counters
+     (docs/OBSERVABILITY.md provenance section).
 
 --raw additionally prints the raw Prometheus exposition text.
 """
@@ -288,6 +291,65 @@ def _timeseries_movers(base: str, top: int = 10) -> None:
     )
 
 
+def _provenance_section(base: str, text: str) -> None:
+    """Decision provenance from /explain (absent on servers predating the
+    MoveLedger — degrade, don't die): the latest run's moves by goal and
+    engine plus its top cost-delta movers, next to the MoveLedger meters."""
+    print("\n== decision provenance (latest recorded run) ==")
+    counters = {}
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        sensor = _parse_labels(labels_raw).get("sensor", "")
+        if sensor.startswith("MoveLedger."):
+            counters[sensor] = float(value)
+    try:
+        doc = json.loads(_get(f"{base}/explain?limit=0"))
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            print("   (no optimization run recorded yet)")
+        else:
+            print(f"   (/explain error: {e})")
+        doc = None
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"   (no /explain endpoint: {e})")
+        doc = None
+    if doc is not None:
+        run = doc.get("run") or {}
+        digest = run.get("digest") or {}
+        print(
+            f"   run {run.get('runId')}: {run.get('numMoves', 0)} moves + "
+            f"{run.get('numLeadership', 0)} leadership, "
+            f"checksum {digest.get('checksum')}"
+        )
+        segments = run.get("segments") or []
+        by_goal = digest.get("byGoal") or {}
+        if segments:
+            header = (
+                f"   {'goal':<38} {'engine':<14} {'phase':<7} {'moves':>6} "
+                f"{'costDelta':>11}"
+            )
+            print(header)
+            print("   " + "-" * (len(header) - 3))
+            movers = sorted(
+                segments, key=lambda s: -abs(s.get("costDelta", 0.0))
+            )[:12]
+            for s in movers:
+                print(
+                    f"   {s['goal']:<38} {s.get('engine', ''):<14} "
+                    f"{s.get('phase', ''):<7} "
+                    f"{s.get('numMoves', 0) + s.get('numLeadership', 0):>6} "
+                    f"{s.get('costDelta', 0.0):>+11.4f}"
+                )
+        elif by_goal:
+            for g, n in sorted(by_goal.items(), key=lambda kv: -kv[1]):
+                print(f"   {g:<52} {n:>8}")
+    for sensor, count in sorted(counters.items()):
+        print(f"   {sensor:<52} {count:>8.0f}")
+
+
 def _sensor_table(text: str) -> None:
     latencies = _parse_prometheus_latencies(text)
     print("\n== sensors (ranked by total seconds) ==")
@@ -321,6 +383,7 @@ def main() -> int:
     _drift_section(metrics_text)
     _perf_section(metrics_text)
     _timeseries_movers(base)
+    _provenance_section(base, metrics_text)
     print(f"\ntracer overhead: {trace.get('overheadS', 0.0):.6f}s")
     if args.raw:
         print("\n== raw /metrics ==")

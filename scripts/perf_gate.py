@@ -23,11 +23,20 @@ Provenance checks (the r05 class):
     is meaningless): exit 4, or pass --allow-platform-mismatch to compare
     anyway (wall/rounds checks are then skipped, provenance-only).
 
+Decision-provenance check: when both records carry a `provenanceDigest`
+(the MoveLedger checksum bench.py embeds) and every perf check passes at
+equal parity, a digest mismatch means the runs made DIFFERENT decisions
+while looking equally good — silent decision drift, not a perf regression.
+It gets its own exit path (5) so pipelines can route it to
+scripts/diff_runs.py instead of a perf triage.
+
 Exit codes (stable; CI scripts may match on them):
   0  pass
   1  regression (any tolerance exceeded or parity flip)
   2  usage / unreadable input
   4  platform mismatch between candidate and baseline fingerprints
+  5  provenance digest mismatch at equal parity (decision drift; run
+     scripts/diff_runs.py on the two runs' ledgers)
 
 Usage:
   python scripts/perf_gate.py BASELINE_DETAIL.json CANDIDATE_DETAIL.json \
@@ -47,6 +56,7 @@ EXIT_PASS = 0
 EXIT_REGRESSION = 1
 EXIT_ERROR = 2
 EXIT_PLATFORM_MISMATCH = 4
+EXIT_DIGEST_MISMATCH = 5
 
 _CONFIG_RE = re.compile(r"BASELINE config (\d+)")
 
@@ -100,13 +110,20 @@ class Gate:
         self.args = args
         self.checks: List[Dict] = []
         self.failed = False
+        #: decision drift (digest mismatch at equal parity) — tracked apart
+        #: from `failed` so it maps to its own exit code when it is the ONLY
+        #: finding (a perf regression still exits 1 and dominates)
+        self.digest_mismatch = False
 
     def check(self, cid: str, name: str, ok: bool, detail: str) -> None:
         self.checks.append(
             {"config": cid, "check": name, "ok": bool(ok), "detail": detail}
         )
         if not ok:
-            self.failed = True
+            if name == "provenanceDigest":
+                self.digest_mismatch = True
+            else:
+                self.failed = True
 
     def compare_pair(self, cid: str, b: Dict, c: Dict, walls: bool) -> None:
         a = self.args
@@ -144,6 +161,18 @@ class Gate:
             self.check(
                 cid, "parityOk", c.get("parityOk") is True,
                 f"parityOk {c.get('parityOk')} vs baseline True",
+            )
+        bd, cd = b.get("provenanceDigest"), c.get("provenanceDigest")
+        if (
+            isinstance(bd, str) and isinstance(cd, str)
+            and b.get("parityOk") == c.get("parityOk")
+        ):
+            # equal parity + different decisions = silent decision drift
+            # (exit 5 when nothing else failed; see module docstring)
+            self.check(
+                cid, "provenanceDigest", cd == bd,
+                f"decision digest {cd} vs baseline {bd} at equal parity "
+                "(run scripts/diff_runs.py on the two runs' ledgers)",
             )
 
 
@@ -207,7 +236,8 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps(
             {"checks": gate.checks,
-             "pass": not gate.failed and not (
+             "digestMismatch": gate.digest_mismatch,
+             "pass": not gate.failed and not gate.digest_mismatch and not (
                  platform_mismatch and not args.allow_platform_mismatch)},
             indent=1,
         ))
@@ -220,7 +250,9 @@ def main(argv=None) -> int:
               f"over {len(pairs)} config pair(s)")
     if platform_mismatch and not args.allow_platform_mismatch:
         return EXIT_PLATFORM_MISMATCH
-    return EXIT_REGRESSION if gate.failed else EXIT_PASS
+    if gate.failed:
+        return EXIT_REGRESSION
+    return EXIT_DIGEST_MISMATCH if gate.digest_mismatch else EXIT_PASS
 
 
 if __name__ == "__main__":

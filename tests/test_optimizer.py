@@ -388,11 +388,18 @@ def test_bulk_round_is_conflict_free_and_consistent():
     assert bool(applied)
     recomputed = compute_aggregates(static, agg2.assignment, dims)
     for name in agg2._fields:
+        if name == "touch_tag":
+            # provenance attribution rides the apply path by design — a
+            # fresh recompute starts it at the untagged sentinel
+            continue
         np.testing.assert_allclose(
             np.asarray(getattr(agg2, name)),
             np.asarray(getattr(recomputed, name)),
             rtol=1e-5, atol=1e-3, err_msg=name,
         )
+    # every cell the round changed carries an attribution tag
+    changed = np.asarray(agg.assignment) != np.asarray(agg2.assignment)
+    assert np.all(np.asarray(agg2.touch_tag)[changed] >= 0)
     sanity_check(model._replace(assignment=np.asarray(agg2.assignment)))
     cost1 = float(goal.cost(static, gs, agg2))
     assert cost1 <= cost0 - 2.0, (cost0, cost1)

@@ -1,0 +1,662 @@
+"""Decision-provenance tests (analyzer/provenance.py, docs/OBSERVABILITY.md).
+
+Host tier (compile-free): tag packing, ledger build/classification from
+synthetic snapshots, MoveLedger bounds + truncation + thread-safety stress,
+run-pair diffing incl. the diff_runs CLI on a seeded perturbed pair, the
+<2%-of-wall overhead contract against the committed bench baseline, config
+plumbing, and /explain over a live server (ledger injected, no XLA).
+
+Compile tier (one small model, few goals): ledger-on vs ledger-off runs are
+byte-identical in proposals, every proposal is answerable with goal/engine/
+round attribution, and the chunked goal machine records the same decisions
+as the fused stack.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer.provenance import (
+    LEDGER,
+    GoalSegment,
+    MoveLedger,
+    MoveRecord,
+    RunLedger,
+    build_run_ledger,
+    decode_tag,
+    diff_ledgers,
+    new_run_id,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- tag packing ---------------------------------------------------------------
+
+
+def test_decode_tag_roundtrip_and_sentinels():
+    from cruise_control_tpu.analyzer.context import TAG_WAVE_BASE
+
+    assert decode_tag(-1) == (-1, -1)
+    assert decode_tag(0) == (0, 0)
+    assert decode_tag(5 * TAG_WAVE_BASE + 7) == (5, 7)
+    # unknown-round apply sites (make_touch_tag(-1, w)) decode to round -1
+    # with the wave preserved
+    assert decode_tag(-TAG_WAVE_BASE + 3) == (-1, 3)
+
+
+def test_make_touch_tag_matches_decoder():
+    from cruise_control_tpu.analyzer.context import make_touch_tag
+
+    assert decode_tag(int(make_touch_tag(12, 3))) == (12, 3)
+    assert decode_tag(int(make_touch_tag(-1, 2))) == (-1, 2)
+
+
+# -- ledger build from synthetic snapshots -------------------------------------
+
+
+def _phase(goal, engine="grid", phase="main", **kw):
+    return {"goal": goal, "engine": engine, "phase": phase, **kw}
+
+
+def test_build_run_ledger_classifies_moves_and_leadership():
+    init = np.array([[0, 1], [2, 3], [4, 5]], np.int32)
+    snap0 = init.copy()
+    snap0[0, 0] = 7  # move: broker 7 is new to row 0
+    snap1 = snap0.copy()
+    snap1[1] = [3, 2]  # leadership: slots swap, same replica set
+    snaps = np.stack([snap0, snap1])
+    tags = np.full((2, 3, 2), -1, np.int32)
+    tags[0, 0, 0] = 2 * 1024 + 1  # round 2, wave 1
+    tags[1, 1, 0] = 3
+    tags[1, 1, 1] = 3
+    led = build_run_ledger(
+        "run-t", [_phase("GoalA", "drain"), _phase("GoalB", "bulk+grid")],
+        init, snaps, tags,
+    )
+    assert [s.goal for s in led.segments] == ["GoalA", "GoalB"]
+    a, b = led.segments
+    assert (a.num_moves, a.num_leadership) == (1, 0)
+    assert (b.num_moves, b.num_leadership) == (0, 2)
+    (mv,) = led.query(goal="GoalA")
+    assert (mv.kind, mv.src, mv.dst, mv.round, mv.wave) == ("move", 0, 7, 2, 1)
+    lead = led.query(goal="GoalB")
+    assert {m.kind for m in lead} == {"leadership"}
+    assert {(m.round, m.wave) for m in lead} == {(0, 3)}
+
+
+def test_build_run_ledger_drops_padding_rows():
+    init = np.zeros((4, 2), np.int32)
+    snap = init[None].copy()
+    snap[0, 3, 0] = 9  # a change in the padding region must not attribute
+    led = build_run_ledger(
+        "run-p", [_phase("G")], init, snap, np.full((1, 4, 2), -1, np.int32),
+        valid_partitions=3,
+    )
+    assert led.moves == []
+
+
+def test_query_filters_and_proposal_view():
+    moves = [
+        MoveRecord(1, 0, "move", 0, 5, "GoalA", "grid", "main", 0, 1, 0),
+        MoveRecord(1, 0, "leadership", 5, 2, "GoalB", "drain", "main", 1, 0, 2),
+        MoveRecord(2, 1, "move", 3, 4, "GoalB", "drain", "polish", 3, 2, 1),
+    ]
+    led = RunLedger("run-q", [], moves)
+    assert len(led.query(partition=1)) == 2
+    assert len(led.query(broker=5)) == 2  # either endpoint
+    assert len(led.query(goal="GoalB")) == 2
+    assert len(led.query(goal="GoalB", kind="move")) == 1
+    assert len(led.query(round=2)) == 1
+    assert len(led.query(phase="polish")) == 1
+    assert len(led.query(limit=1)) == 1
+    view = led.proposal_view()
+    assert [v["partition"] for v in view] == [1, 2]
+    assert view[0]["provenanceId"] == "run-q/p1"
+    assert view[0]["goals"] == ["GoalA", "GoalB"]
+    (only,) = led.proposal_view(partition=2)
+    assert only["partition"] == 2
+
+
+def test_digest_is_order_invariant_and_decision_sensitive():
+    m1 = MoveRecord(1, 0, "move", 0, 5, "G", "grid", "main", 0, 1, 0)
+    m2 = MoveRecord(2, 0, "move", 1, 4, "G", "grid", "main", 0, 1, 1)
+    seg = GoalSegment("G", "grid", "main", 0, 4.0, 1.0, 3, 0, 5, True, 2, 0)
+    d1 = RunLedger("a", [seg], [m1, m2]).digest()
+    d2 = RunLedger("b", [seg], [m2, m1]).digest()  # recording order differs
+    assert d1["checksum"] == d2["checksum"]
+    assert d1["byGoal"] == {"G": 2}
+    assert d1["costDelta"] == {"G": -3.0}
+    d3 = RunLedger("c", [seg], [m1, m2._replace(dst=3)]).digest()
+    assert d3["checksum"] != d1["checksum"]
+
+
+def test_run_ledger_json_roundtrip():
+    led = RunLedger(
+        "run-r",
+        [GoalSegment("G", "drain", "main", 0, 1.0, 0.5, 2, 1, 7, True, 1, 0)],
+        [MoveRecord(3, 1, "move", 2, 6, "G", "drain", "main", 0, 4, 2)],
+        meta={"bucket": "P8-B8-T4-RF2"},
+    )
+    back = RunLedger.from_dict(json.loads(json.dumps(led.to_dict())))
+    assert back.run_id == led.run_id
+    assert back.moves == led.moves
+    assert back.segments == led.segments
+    assert back.digest()["checksum"] == led.digest()["checksum"]
+
+
+# -- MoveLedger registry bounds + thread safety --------------------------------
+
+
+def _mini_run(run_id, n_moves=1):
+    return RunLedger(
+        run_id, [],
+        [MoveRecord(i, 0, "move", 0, 1, "G", "grid", "main", 0, 0, 0)
+         for i in range(n_moves)],
+    )
+
+
+def test_move_ledger_bounds_runs_and_truncates_moves_loudly():
+    reg = MoveLedger(max_runs=2, max_moves_per_run=3)
+    for i in range(4):
+        reg.record(_mini_run(f"r{i}"))
+    assert reg.run_ids() == ["r2", "r3"]
+    assert reg.get("r0") is None and reg.latest().run_id == "r3"
+    reg.record(_mini_run("big", n_moves=5))
+    big = reg.get("big")
+    assert len(big.moves) == 3
+    assert big.meta["truncatedMoves"] == 2  # never silently dropped
+    st = reg.state()
+    assert st["capacity"] == 2 and st["totalRecorded"] == 5
+    reg.configure(max_runs=1)
+    assert reg.run_ids() == ["big"]
+
+
+def test_move_ledger_thread_safety_stress():
+    reg = MoveLedger(max_runs=4)
+    errors = []
+
+    def writer(k):
+        try:
+            for i in range(200):
+                reg.record(_mini_run(f"w{k}-{i}", n_moves=2))
+        except Exception as e:  # pragma: no cover - the assertion IS the test
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(400):
+                reg.latest()
+                reg.state()
+                reg.run_ids()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(k,)) for k in range(4)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(reg.run_ids()) <= 4
+    assert reg.state()["totalRecorded"] == 800
+
+
+def test_new_run_id_unique():
+    ids = {new_run_id() for _ in range(50)}
+    assert len(ids) == 50
+
+
+# -- run-pair diffing ----------------------------------------------------------
+
+
+def _seeded_pair(perturb: bool):
+    """Two ledgers over the same decision stream; `perturb` flips one
+    mid-stream destination (the seeded first divergence)."""
+    moves = [
+        MoveRecord(p, 0, "move", 0, 1 + (p % 3), "GoalA", "grid", "main", 0,
+                   p // 4, p % 4)
+        for p in range(12)
+    ]
+    seg = GoalSegment("GoalA", "grid", "main", 0, 9.0, 1.0, 4, 0, 3, True, 12, 0)
+    a = RunLedger("run-a", [seg], moves)
+    b_moves = list(moves)
+    if perturb:
+        b_moves[7] = b_moves[7]._replace(dst=5)
+    b = RunLedger("run-b", [dataclasses.replace(seg, cost_after=1.5)], b_moves)
+    return a, b
+
+
+def test_diff_ledgers_identical_and_first_divergence():
+    a, b = _seeded_pair(perturb=False)
+    rep = diff_ledgers(a, b)
+    assert rep["identical"] is True
+    a, b = _seeded_pair(perturb=True)
+    rep = diff_ledgers(a, b)
+    assert rep["identical"] is False
+    fd = rep["firstDivergence"]
+    # canonical order sorts by (goal_index, round, wave, partition, slot)
+    assert fd["a"]["partition"] == 7 and fd["b"]["dst"] == 5
+    assert rep["firstDivergenceGoal"] == "GoalA"
+    (seg_delta,) = rep["segments"]
+    assert seg_delta["costAfterDelta"] == pytest.approx(-0.5)
+
+
+def test_diff_ledgers_one_sided_tail():
+    a, b = _seeded_pair(perturb=False)
+    b.moves = b.moves[:-2]
+    rep = diff_ledgers(a, b)
+    assert not rep["identical"]
+    assert rep["firstDivergence"]["b"] is None
+
+
+def test_diff_runs_cli_reports_first_divergence(tmp_path, capsys):
+    from scripts.diff_runs import main as diff_main
+
+    a, b = _seeded_pair(perturb=True)
+    pa = tmp_path / "a.json"
+    pb = tmp_path / "b.json"
+    pa.write_text(json.dumps({"ledger": a.to_dict()}))
+    pb.write_text(json.dumps(b.to_dict()))  # bare dict form also accepted
+    assert diff_main([str(pa), str(pb)]) == 1
+    out = capsys.readouterr().out
+    assert "FIRST DIVERGENT MOVE" in out and "GoalA" in out
+    assert diff_main([str(pa), str(pa), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["identical"] is True
+    with pytest.raises(SystemExit) as e:
+        diff_main([str(tmp_path / "missing.json"), str(pa)])
+    assert e.value.code == 2
+
+
+# -- perf_gate digest exit path ------------------------------------------------
+
+
+def _gate_doc(digest, parity=True):
+    return {
+        "configs": [{
+            "metric": "full-goal proposal generation, BASELINE config 1 (x)",
+            "value": 1.0, "moves": 10, "parityOk": parity,
+            "provenanceDigest": digest,
+            "fingerprint": {"platform": "cpu", "probeFallback": False},
+        }],
+        "fingerprint": {"platform": "cpu", "probeFallback": False},
+    }
+
+
+def test_perf_gate_flags_digest_mismatch_as_exit_5(tmp_path):
+    from scripts.perf_gate import EXIT_DIGEST_MISMATCH, EXIT_PASS, main as gate
+
+    base = tmp_path / "base.json"
+    cand = tmp_path / "cand.json"
+    base.write_text(json.dumps(_gate_doc("aaaa")))
+    cand.write_text(json.dumps(_gate_doc("aaaa")))
+    assert gate([str(base), str(cand)]) == EXIT_PASS
+    cand.write_text(json.dumps(_gate_doc("bbbb")))
+    # equal parity, equal perf, different decisions -> the distinct exit path
+    assert gate([str(base), str(cand)]) == EXIT_DIGEST_MISMATCH
+    # a real regression dominates the digest signal
+    doc = _gate_doc("bbbb")
+    doc["configs"][0]["value"] = 99.0
+    cand.write_text(json.dumps(doc))
+    assert gate([str(base), str(cand)]) == 1
+    # unequal parity: the digest is expected to differ, no digest finding
+    doc = _gate_doc("bbbb", parity=False)
+    doc["configs"][0]["value"] = 1.0
+    cand.write_text(json.dumps(doc))
+    assert gate([str(base), str(cand)]) == 1  # parity flip only
+
+
+# -- overhead contract ---------------------------------------------------------
+
+
+def test_ledger_build_overhead_under_2pct_of_proposal_wall():
+    """The acceptance contract, PR-2/PR-7 style: building the attribution
+    ledger for a config-1-shaped run (the committed baseline's FASTEST
+    config — every real proposal is slower, so the bound is tighter than
+    production sees) must cost <2% of that config's recorded wall. The
+    build cost scales with moves made (np.nonzero prefilter), not with
+    partitions examined."""
+    detail = json.loads((REPO / "BENCH_DETAIL.json").read_text())
+    cfg1 = next(c for c in detail["configs"] if "config 1" in c["metric"])
+    wall = float(cfg1["value"])
+    n_moves = max(1, int(cfg1.get("moves", 64)))
+    p, r, phases = 1024, 2, 2
+    rng = np.random.default_rng(7)
+    init = rng.integers(0, 20, size=(p, r)).astype(np.int32)
+    snap = np.broadcast_to(init, (phases, p, r)).copy()
+    rows = rng.choice(p, size=n_moves, replace=False)
+    snap[0, rows, 0] = 20 + (rows % 4).astype(np.int32)
+    snap[1] = snap[0]
+    tags = np.full((phases, p, r), -1, np.int32)
+    tags[0, rows, 0] = 1024 + 1
+    phase_meta = [_phase("GoalA", "drain"), _phase("GoalB", "grid")]
+    # min over repeats: the contract bounds the BUILD's cost, not scheduler
+    # noise on a loaded single-core CI box (same posture as time.monotonic
+    # best-case in timeit)
+    per_run = float("inf")
+    for _ in range(7):
+        t0 = time.monotonic()
+        led = build_run_ledger("run-o", phase_meta, init, snap, tags)
+        per_run = min(per_run, time.monotonic() - t0)
+    assert len(led.moves) == n_moves
+    budget = 0.02 * wall
+    assert per_run < budget, (
+        f"ledger build cost {per_run * 1e6:.0f}us/run for {n_moves} moves, "
+        f"budget {budget * 1e6:.0f}us (2% of the {wall}s config-1 wall)"
+    )
+
+
+# -- config plumbing -----------------------------------------------------------
+
+
+def test_provenance_config_keys_reach_settings_and_registry():
+    from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({})
+    assert OptimizerSettings.from_config(cfg).ledger is True
+    cfg_off = CruiseControlConfig({"optimizer.provenance.ledger": "false"})
+    assert OptimizerSettings.from_config(cfg_off).ledger is False
+    assert cfg.get_int("observability.ledger.runs") == 8
+    reg = MoveLedger(max_runs=2)
+    reg.configure(max_runs=cfg.get_int("observability.ledger.runs"))
+    assert reg.state()["capacity"] == 8
+
+
+# -- executor provenance join --------------------------------------------------
+
+
+def test_executor_threads_provenance_ids_into_terminal_events_and_trims():
+    from cruise_control_tpu.executor import (
+        Executor,
+        ExecutorConfig,
+        SimulatorClusterDriver,
+        TopologyFingerprint,
+    )
+    from cruise_control_tpu.executor import validation as V
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+    sim = SimulatedCluster(random_cluster(
+        7, ClusterProperty(num_racks=3, num_brokers=6, num_topics=4,
+                           replication_factor=2)
+    ))
+    mc = MetadataClient(sim.fetch_topology, ttl_s=0.0)
+    events = []
+    execu = Executor(
+        SimulatorClusterDriver(sim, latency_polls=1),
+        config=ExecutorConfig(execution_progress_check_interval_s=0.002),
+        topology_source=lambda: mc.refresh_metadata(force=True),
+        generation_source=lambda: mc.generation,
+        notifier=lambda kind, info: events.append((kind, info)),
+    )
+    topo = mc.refresh_metadata(force=True)
+
+    def movement(row):
+        old = tuple(int(b) for b in np.asarray(topo.assignment)[row] if b >= 0)
+        dead = set(np.nonzero(np.asarray(topo.broker_state) == 2)[0])
+        dst = next(b for b in range(topo.num_brokers)
+                   if b not in old and b not in dead)
+        from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+
+        return ExecutionProposal(partition=row, old_replicas=old,
+                                 new_replicas=(dst,) + old[1:])
+
+    good, stale = movement(0), movement(1)
+    if good.replicas_to_add[0] == stale.replicas_to_add[0]:
+        pytest.skip("seed picked the same destination twice")
+    gen = mc.generation
+    fp = TopologyFingerprint.from_topology(topo)
+    sim.kill_broker(stale.replicas_to_add[0])
+    summary = execu.execute_proposals(
+        [good, stale], generation=gen, fingerprint=fp,
+        provenance_run="run-xyz",
+    )
+    v = summary["proposalValidation"]
+    assert v["provenanceRun"] == "run-xyz"
+    (t,) = v["trimmed"]
+    assert t["reason"] == V.DEST_DEAD
+    assert t["provenanceId"] == f"run-xyz/p{stale.partition}"
+    # the completed task's terminal event carries its provenance id too
+    # (the admission-trimmed proposal never became a task — its provenance
+    # lives in the trim record asserted above)
+    terminal = execu._manager.tracker.terminal_events()
+    by_state = {e["state"]: e for e in terminal}
+    assert by_state["COMPLETED"]["provenanceId"] == f"run-xyz/p{good.partition}"
+    completed_events = [i for k, i in events if k == "task_completed"]
+    assert completed_events and completed_events[0]["provenanceId"] == (
+        f"run-xyz/p{good.partition}"
+    )
+
+
+# -- optimizer collection (compile tier) ---------------------------------------
+
+
+def _ledger_model_and_goals():
+    from cruise_control_tpu.common.resources import BrokerState
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+
+    model = random_cluster(3, ClusterProperty(
+        num_racks=3, num_brokers=6, num_topics=4, replication_factor=2,
+    ))
+    state = np.asarray(model.broker_state).copy()
+    state[0] = BrokerState.DEAD
+    model = model._replace(broker_state=state)
+    goals = ["RackAwareGoal", "ReplicaDistributionGoal",
+             "LeaderReplicaDistributionGoal"]
+    return model, goals
+
+
+def _ledger_run(model, goals, **kw):
+    from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+
+    opt = GoalOptimizer(settings=OptimizerSettings(
+        batch_k=4, max_rounds_per_goal=16, **kw,
+    ))
+    return opt.optimizations(model, goal_names=goals,
+                             raise_on_hard_failure=False)
+
+
+@pytest.fixture(scope="module")
+def ledger_runs():
+    """One small dead-broker model through the fused stack with the ledger
+    on and off (two small compiles; the chunked-machine variant compiles the
+    full default-stack program and rides the slow lane)."""
+    model, goals = _ledger_model_and_goals()
+    return {
+        "goals": goals,
+        "on": _ledger_run(model, goals),
+        "off": _ledger_run(model, goals, ledger=False),
+    }
+
+
+def test_ledger_on_off_proposals_byte_identical(ledger_runs):
+    on, off = ledger_runs["on"], ledger_runs["off"]
+    assert off.provenance is None
+    assert on.provenance is not None
+    assert [p.to_dict() for p in on.proposals] == [p.to_dict() for p in off.proposals]
+    assert np.array_equal(on.final_assignment, off.final_assignment)
+
+
+def test_every_proposal_is_answerable_with_attribution(ledger_runs):
+    on = ledger_runs["on"]
+    led = on.provenance
+    assert on.proposals, "fixture model must produce moves"
+    attributed = {m.partition for m in led.moves}
+    for p in on.proposals:
+        assert p.partition in attributed
+        for m in led.query(partition=p.partition):
+            assert m.goal in ledger_runs["goals"]
+            assert m.engine
+            assert m.round >= 0 and m.wave >= 0
+            assert m.kind in ("move", "leadership")
+    # segments carry the acceptance outcome context
+    segs = {s.goal: s for s in led.segments}
+    assert set(segs) == set(ledger_runs["goals"])
+    for s in segs.values():
+        assert s.rounds >= 0 and isinstance(s.converged, bool)
+    # summary/digest surfaces through OptimizerResult.summary()
+    summ = on.summary()
+    assert summ["provenance"]["runId"] == led.run_id
+    assert summ["provenance"]["digest"]["moves"] == len(led.moves)
+    # and the run landed in the process registry for /explain
+    assert LEDGER.get(led.run_id) is led
+
+
+@pytest.mark.slow
+def test_chunked_machine_records_same_decisions(ledger_runs):
+    """Slow lane: the chunked machine traces the FULL default-stack program
+    (the runtime subset mask) — a compile far heavier than the subject under
+    test. The fast lane covers fused collection; the bench's chunked ledgers
+    exercise this path at scale."""
+    model, goals = _ledger_model_and_goals()
+    chunked = _ledger_run(model, goals, chunk_rounds=4)
+    on = ledger_runs["on"]
+    assert [p.to_dict() for p in on.proposals] == [
+        p.to_dict() for p in chunked.proposals
+    ]
+    led = chunked.provenance
+    assert led is not None
+    # the machine ran the full default stack with a runtime subset mask;
+    # disabled goals' phases contribute no segments and no moves, and the
+    # kept phases are renumbered to the requested order
+    assert {s.goal for s in led.segments} == set(goals)
+    assert {m.goal for m in led.moves} <= set(goals)
+    # same net decisions as the fused stack (same kernels, same order)
+    assert diff_ledgers(on.provenance, led)["identical"] is True
+
+
+# -- /explain over a live server (compile-free) --------------------------------
+
+
+@pytest.fixture(scope="module")
+def explain_server():
+    import asyncio
+    import socket
+
+    from aiohttp import web
+
+    from cruise_control_tpu.async_ops import AsyncCruiseControl
+    from cruise_control_tpu.executor import Executor, SimulatorClusterDriver
+    from cruise_control_tpu.facade import CruiseControl, FacadeConfig
+    from cruise_control_tpu.models.generators import ClusterProperty, random_cluster
+    from cruise_control_tpu.monitor.completeness import ModelCompletenessRequirements
+    from cruise_control_tpu.monitor.load_monitor import LoadMonitor, LoadMonitorConfig
+    from cruise_control_tpu.monitor.metadata import MetadataClient
+    from cruise_control_tpu.monitor.sampler import TransportMetricSampler
+    from cruise_control_tpu.reporter.transport import InMemoryTransport
+    from cruise_control_tpu.servlet.server import CruiseControlApp
+    from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+    truth = random_cluster(
+        7, ClusterProperty(num_racks=2, num_brokers=4, num_topics=3,
+                           replication_factor=2)
+    )
+    sim = SimulatedCluster(truth)
+    monitor = LoadMonitor(
+        MetadataClient(sim.fetch_topology, ttl_s=0.0),
+        TransportMetricSampler(InMemoryTransport()),
+        config=LoadMonitorConfig(window_ms=1000, num_windows=3,
+                                 min_samples_per_window=1),
+    )
+    executor = Executor(SimulatorClusterDriver(sim), load_monitor=monitor)
+    facade = CruiseControl(
+        monitor, executor,
+        config=FacadeConfig(
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+        ),
+    )
+    acc = AsyncCruiseControl(facade)
+    app = CruiseControlApp(acc, response_wait_s=0.2)
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app.build_app())
+        loop.run_until_complete(runner.setup())
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        loop.run_until_complete(site.start())
+        started.set()
+        loop.run_forever()
+        loop.run_until_complete(runner.cleanup())
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    assert started.wait(10)
+    yield {"url": f"http://127.0.0.1:{port}"}
+    loop.call_soon_threadsafe(loop.stop)
+    th.join(timeout=5)
+    acc.shutdown()
+
+
+def _http_get(url: str):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_explain_endpoint_serves_recorded_run(explain_server):
+    seg = GoalSegment("GoalA", "bulk+grid", "main", 0, 3.0, 0.0, 2, 0, 4,
+                      True, 2, 1)
+    moves = [
+        MoveRecord(5, 0, "move", 0, 2, "GoalA", "bulk+grid", "main", 0, 1, 0),
+        MoveRecord(5, 0, "leadership", 2, 1, "GoalA", "bulk+grid", "main", 0, 2, 1),
+        MoveRecord(9, 1, "move", 1, 3, "GoalA", "bulk+grid", "main", 0, 1, 2),
+    ]
+    run_id = new_run_id()
+    LEDGER.record(RunLedger(run_id, [seg], moves))
+    base = explain_server["url"]
+    for path in (f"/explain?run={run_id}",
+                 f"/kafkacruisecontrol/explain?run={run_id}"):
+        status, doc = _http_get(base + path)
+        assert status == 200
+        assert doc["run"]["runId"] == run_id
+        assert doc["run"]["digest"]["byGoal"] == {"GoalA": 3}
+        assert len(doc["moves"]) == 3
+    # filters
+    status, doc = _http_get(base + f"/explain?run={run_id}&partition=5")
+    assert status == 200 and len(doc["moves"]) == 2
+    status, doc = _http_get(base + f"/explain?run={run_id}&broker=3")
+    assert [m["partition"] for m in doc["moves"]] == [9]
+    status, doc = _http_get(base + f"/explain?run={run_id}&kind=leadership")
+    assert len(doc["moves"]) == 1 and doc["moves"][0]["round"] == 2
+    status, doc = _http_get(base + f"/explain?run={run_id}&round=1")
+    assert len(doc["moves"]) == 2
+    # proposal-level view
+    status, doc = _http_get(
+        base + f"/explain?run={run_id}&view=proposal&partition=5"
+    )
+    assert status == 200
+    (prop,) = doc["proposals"]
+    assert prop["provenanceId"] == f"{run_id}/p5"
+    assert len(prop["moves"]) == 2
+    # segments ride every response
+    assert doc["run"]["segments"][0]["goal"] == "GoalA"
+
+
+def test_explain_endpoint_error_paths(explain_server):
+    base = explain_server["url"]
+    status, doc = _http_get(base + "/explain?run=run-nonexistent")
+    assert status == 404 and "unknown run" in doc["errorMessage"]
+    status, doc = _http_get(base + "/explain?partition=nope")
+    assert status == 400
+    status, doc = _http_get(base + "/explain?view=bogus")
+    assert status == 400

@@ -1,11 +1,16 @@
-"""cclint: repo-native static analysis for the TPU, concurrency, and
-registry invariants the codebase rests on (docs/LINTING.md).
+"""cclint: repo-native static analysis for the TPU, concurrency, registry,
+and jaxpr-level invariants the codebase rests on (docs/LINTING.md).
 
-Three rule families over pure-AST/text analysis (no JAX import, tier-1
-cheap): `tpu` guards the shape-bucketed kernel contract, `concurrency`
-generalizes the never-raise/lock-discipline contracts package-wide, and
-`registry` reconciles config keys, sensor names, and span kinds against
-their declarations and documentation. CLI: `scripts/cclint.py`.
+Two tiers. The `token` tier is pure-AST/text analysis (no JAX import):
+`tpu` guards the shape-bucketed kernel contract, `concurrency` generalizes
+the never-raise/lock-discipline contracts package-wide, and `registry`
+reconciles config keys, sensor names, and span kinds against their
+declarations and documentation. The `trace` tier abstractly evaluates the
+REAL jitted entry points registered in lint/entrypoints.py and walks their
+jaxprs for the contracts token rules cannot see — host callbacks under
+jit, dead donations, bucket-unstable loop carries, baked constants, and
+sharding readiness under the 8-device mesh — with results content-hash
+cached so repeat runs stay tier-1 cheap. CLI: `scripts/cclint.py`.
 """
 
 from cruise_control_tpu.lint.core import (  # noqa: F401
@@ -16,10 +21,12 @@ from cruise_control_tpu.lint.core import (  # noqa: F401
     LintContext,
     Rule,
     RULES,
+    TIERS,
     all_rules,
     build_context,
     render_human,
     render_json,
     run_rules,
+    tier_rules,
     unsuppressed,
 )

@@ -1,15 +1,22 @@
 """cclint command line: `python scripts/cclint.py` / `python -m cruise_control_tpu.lint`.
 
+Both entry points are THIS function — there is exactly one CLI, pinned by
+tests/test_lint_trace.py's exit-code identity cases.
+
 Exit codes (stable):
   0  clean (no unsuppressed findings)
   1  unsuppressed findings
   2  usage or internal error
 
-`--json` emits the machine schema (version/findings/summary); the default
-human format is one `path:line: rule  message` per finding plus a summary
-line. `--changed-only` lints the full context (registry rules need every
-file) but reports only findings in files that differ from `--base` (default
-`main`) or are locally modified/untracked — the fast local loop.
+`--tier` selects the analysis tier: `token` (pure ast/text — the fast local
+loop), `trace` (jaxpr-level evaluation of the registered kernel entry
+points, content-hash cached), or `all` (default; what CI runs). `--json`
+emits the machine schema v2 (per-rule family/tier/wall-time plus the trace
+cache verdict); the default human format is one `path:line: rule  message`
+per finding plus a summary line. `--changed-only` lints the full context
+(registry rules need every file) but reports only findings in files that
+differ from `--base` (default `main`) or are locally modified/untracked —
+stale suppressions for the selected rules are judged on these runs too.
 """
 
 from __future__ import annotations
@@ -30,6 +37,7 @@ from cruise_control_tpu.lint.core import (
     render_human,
     render_json,
     run_rules,
+    tier_rules,
     unsuppressed,
 )
 
@@ -66,8 +74,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="cclint",
         description="repo-native static analysis: TPU hygiene, concurrency "
-                    "discipline, config/sensor registry consistency "
-                    "(docs/LINTING.md)",
+                    "discipline, config/sensor registry consistency, and "
+                    "jaxpr-level kernel certification (docs/LINTING.md)",
     )
     parser.add_argument("paths", nargs="*", type=pathlib.Path,
                         help="files or directories to lint (default: the "
@@ -75,9 +83,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--root", type=pathlib.Path, default=None,
                         help="repo root (default: auto from this file)")
     parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="machine-readable output")
+                        help="machine-readable output (schema v2)")
     parser.add_argument("--rule", action="append", default=None, metavar="ID",
-                        help="run only this rule (repeatable)")
+                        help="run only this rule (repeatable; overrides --tier)")
+    parser.add_argument("--tier", choices=("token", "trace", "all"),
+                        default="all",
+                        help="analysis tier: token = ast/text rules only, "
+                             "trace = jaxpr-level entry-point rules only, "
+                             "all = both (default)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     parser.add_argument("--changed-only", action="store_true",
@@ -91,7 +104,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     rules = all_rules()
     if args.list_rules:
         for r in rules:
-            print(f"{r.id:28s} [{r.family}] {r.rationale}")
+            print(f"{r.id:28s} [{r.family}/{r.tier}] {r.rationale}")
         return EXIT_CLEAN
 
     if args.rule:
@@ -101,6 +114,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                   file=sys.stderr)
             return EXIT_ERROR
         rules = [RULES[rid] for rid in args.rule]
+    else:
+        rules = tier_rules(args.tier)
 
     root = args.root
     if root is None:
@@ -111,7 +126,8 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"cclint: cannot read sources: {e}", file=sys.stderr)
         return EXIT_ERROR
 
-    findings = run_rules(ctx, rules=rules)
+    timings: dict = {}
+    findings = run_rules(ctx, rules=rules, timings=timings)
 
     if args.changed_only:
         changed = changed_paths(root, base=args.base)
@@ -122,9 +138,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             changed_set = set(changed)
             findings = [f for f in findings if f.path in changed_set]
 
-    rule_ids = [r.id for r in rules]
     if args.as_json:
-        print(render_json(findings, len(ctx.files), rule_ids))
+        print(render_json(findings, len(ctx.files), rules, timings=timings,
+                          trace_stats=ctx.cache.get("trace-stats")))
     else:
         print(render_human(findings, len(ctx.files), len(rules),
                            show_suppressed=args.show_suppressed))

@@ -13,10 +13,17 @@ suppression syntax that *requires* a written justification:
 
 A suppression with no `-- reason` is itself a finding
 (`lint-malformed-suppression`); a suppression that stops matching anything
-is too (`lint-unused-suppression`, checked on full-rule-set runs), so the
-escape hatch cannot silently rot. Everything here is pure `ast` + text —
-no JAX import, no compilation — so the full-package run stays tier-1 cheap
-(<10 s; see tests/test_static_guards.py).
+is too (`lint-unused-suppression`, judged per selected rule, so partial
+runs — `--rule`, `--tier`, `--changed-only` — still retire stale debt for
+the rules they ran), so the escape hatch cannot silently rot.
+
+Rules come in two TIERS. The `token` tier is pure `ast` + text — no JAX
+import, no compilation — and stays tier-1 cheap on every run. The `trace`
+tier (rules_trace.py) abstractly evaluates the REAL jitted entry points
+declared in lint/entrypoints.py and walks their jaxprs; it pays one
+JAX-tracing subprocess per linted file set, memoized on disk keyed by
+source content hash, so repeat runs stay inside the same <10 s budget
+(see tests/test_static_guards.py).
 
 Entry points: `scripts/cclint.py` (CLI, JSON or human output, stable exit
 codes) and `run_rules()` (the tier-1 test drives it directly). Rule catalog
@@ -32,6 +39,7 @@ import io
 import json
 import pathlib
 import re
+import time
 import tokenize
 from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -221,11 +229,18 @@ def build_context(
 # -- rule registry -------------------------------------------------------------
 
 
+#: the two analysis tiers (CLI `--tier`): `token` rules read source text
+#: and ASTs; `trace` rules abstractly evaluate the registered jitted entry
+#: points and walk the resulting jaxprs (rules_trace.py)
+TIERS = ("token", "trace")
+
+
 class Rule:
     """Base class: subclass, set the class attributes, implement check()."""
 
     id: str = ""
-    family: str = ""  # "tpu" | "concurrency" | "registry" | "lint"
+    family: str = ""  # "tpu" | "concurrency" | "registry" | "trace" | "lint"
+    tier: str = "token"  # "token" (ast/text) | "trace" (jaxpr-level)
     rationale: str = ""
 
     def check(self, ctx: LintContext) -> Iterator[Finding]:
@@ -255,9 +270,20 @@ def all_rules() -> List[Rule]:
         rules_concurrency,
         rules_registry,
         rules_tpu,
+        rules_trace,
     )
 
     return sorted(RULES.values(), key=lambda r: (r.family, r.id))
+
+
+def tier_rules(tier: str) -> List[Rule]:
+    """The rule subset for a CLI `--tier` selection (`token`/`trace`/`all`)."""
+    rules = all_rules()
+    if tier == "all":
+        return rules
+    if tier not in TIERS:
+        raise ValueError(f"unknown tier {tier!r}; expected one of {TIERS + ('all',)}")
+    return [r for r in rules if r.tier == tier]
 
 
 # -- meta rules (emitted by the runner, registered so they are cataloged) ------
@@ -303,19 +329,26 @@ def run_rules(
     ctx: LintContext,
     rules: Optional[Sequence[Rule]] = None,
     check_unused: Optional[bool] = None,
+    timings: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Run `rules` (default: all registered) over the context.
 
     Suppression semantics: a finding on line N is suppressed by a
     well-formed `# cclint: disable=<rule>[,<rule>...] -- reason` comment on
-    line N, or standalone on line N-1. `check_unused` defaults to True only
-    when the full rule set runs (a partial run cannot judge staleness).
+    line N, or standalone on line N-1. Staleness is judged PER SELECTED
+    RULE: a suppression naming a rule that ran and matched nothing is flagged
+    even on partial (`--rule`/`--tier`/`--changed-only`) runs — only rules
+    that did not run are off the table (a partial run cannot judge them).
+    A suppression naming a rule id that does not exist at all is always
+    stale. `check_unused=False` disables the staleness pass entirely.
+
+    `timings`, when given, is filled with per-rule wall seconds (the
+    `--json` schema's wallMs; a trace rule's first check carries the shared
+    jaxpr-evaluation payload for its tier, cache permitting).
     """
     selected = list(rules) if rules is not None else all_rules()
     if check_unused is None:
-        check_unused = {r.id for r in selected} >= {
-            r.id for r in all_rules() if r.id not in _META_RULES
-        }
+        check_unused = True
     findings: List[Finding] = []
     for src in ctx.files:
         if src.parse_error is not None:
@@ -332,7 +365,10 @@ def run_rules(
                             " -- <justification>` (reason is mandatory)",
                 ))
     for rule in selected:
+        t0 = time.monotonic()
         findings.extend(rule.check(ctx))
+        if timings is not None:
+            timings[rule.id] = timings.get(rule.id, 0.0) + (time.monotonic() - t0)
     by_rel = {src.rel: src for src in ctx.files}
     for f in findings:
         src = by_rel.get(f.path)
@@ -344,11 +380,20 @@ def run_rules(
             f.suppress_reason = sup.reason
             sup.used.add(f.rule)
     if check_unused:
+        # per-rule-scoped staleness: only rules that actually ran (or ids
+        # that exist in no registry — typos) are judged, so a `--tier token`
+        # or `--rule X` run cannot false-flag a live trace-rule suppression
+        selected_ids = {r.id for r in selected}
+        known_ids = {r.id for r in all_rules()}
         for src in ctx.files:
             for sup in src.suppressions.values():
                 if sup.malformed:
                     continue
-                stale = [r for r in sup.rules if r not in sup.used]
+                stale = [
+                    r for r in sup.rules
+                    if (r in selected_ids or r not in known_ids)
+                    and r not in sup.used
+                ]
                 for r in stale:
                     findings.append(Finding(
                         rule="lint-unused-suppression", path=src.rel,
@@ -385,14 +430,27 @@ def render_human(findings: Sequence[Finding], num_files: int,
 
 
 def render_json(findings: Sequence[Finding], num_files: int,
-                rule_ids: Sequence[str]) -> str:
+                rules: Sequence[Rule],
+                timings: Optional[Dict[str, float]] = None,
+                trace_stats: Optional[Dict] = None) -> str:
+    """Schema v2: every rule row carries its family, tier, and wall-time
+    (CI archives this artifact next to the tier-1 log — scripts/ci.sh)."""
     by_rule: Dict[str, int] = {}
     for f in findings:
         if not f.suppressed:
             by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-    return json.dumps({
-        "version": 1,
-        "rules": list(rule_ids),
+    timings = timings or {}
+    doc = {
+        "version": 2,
+        "rules": [
+            {
+                "id": r.id,
+                "family": r.family,
+                "tier": r.tier,
+                "wallMs": round(timings.get(r.id, 0.0) * 1000.0, 3),
+            }
+            for r in rules
+        ],
         "numFiles": num_files,
         "findings": [f.to_dict() for f in findings],
         "summary": {
@@ -401,7 +459,10 @@ def render_json(findings: Sequence[Finding], num_files: int,
             "suppressed": len(findings) - len(unsuppressed(findings)),
             "byRule": dict(sorted(by_rule.items())),
         },
-    }, indent=2)
+    }
+    if trace_stats is not None:
+        doc["trace"] = trace_stats
+    return json.dumps(doc, indent=2)
 
 
 # -- shared AST helpers --------------------------------------------------------

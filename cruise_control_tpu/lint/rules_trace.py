@@ -1,0 +1,310 @@
+"""Trace-tier rules (family `trace`): jaxpr-level contracts the token rules
+cannot see.
+
+The token tier reads source text; these rules read the PROGRAM. Every
+module declaring a `CCLINT_TRACE_ENTRYPOINTS` registry (lint/entrypoints.py
+registers the real fused stack, chunked goal machine, bulk/drain/swap round
+kernels, and the parallel/sharding dispatch surfaces) is handed to a
+JAX-tracing subprocess (lint/trace_worker.py) that abstractly evaluates
+each entry with `jax.make_jaxpr` / a sharded lower+compile and reports
+violations of five contracts:
+
+  trace-host-callback      no pure/debug/io_callback primitive under jit
+  trace-donation-integrity every donate_argnums buffer aliases an output
+  trace-carry-stability    while/scan carries bucket-stable (no weak_type,
+                           no float64, no shape/pytree drift)
+  trace-constant-bloat     no oversized closure-captured program constants
+  trace-sharding-lowering  sharded entries lower+compile under a virtual
+                           8-device mesh without replication-forcing ops
+  trace-entry-error        the registry itself is well-formed and traceable
+
+Cost model: the subprocess pays a real JAX import plus ~10 s of tracing for
+the full goal stack, so results are cached on disk keyed by the CONTENT
+HASH of the linted sources (plus jax/jaxlib versions and the worker schema)
+— a repeat run with unchanged sources never spawns the worker and the
+combined token+trace package run stays inside the PR-6 <10 s budget
+(tests/test_lint_trace.py pins hit/miss/invalidation and the budget).
+
+This module itself imports no JAX: version strings come from package
+metadata, and all tracing happens in the worker subprocess.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import pathlib
+import subprocess
+import sys
+from typing import Dict, Iterator, List
+
+from cruise_control_tpu.lint.core import (
+    Finding,
+    LintContext,
+    Rule,
+    SourceFile,
+    register,
+)
+from cruise_control_tpu.lint.trace_worker import WORKER_SCHEMA
+
+#: cache directory: env override (tests point it at tmp), else a dot-dir at
+#: the repo root. Entries for the committed tree are committed alongside the
+#: sources so a fresh checkout's first CI run is already warm.
+CACHE_ENV = "CCLINT_TRACE_CACHE"
+#: worker wall-clock ceiling (seconds); the full-stack trace is ~25 s cold
+TIMEOUT_ENV = "CCLINT_TRACE_TIMEOUT"
+DEFAULT_TIMEOUT_S = 540.0
+
+#: process-lifetime cache counters, reset-able by tests
+CACHE_STATS = {"hits": 0, "misses": 0}
+
+_REGISTRY_NAME = "CCLINT_TRACE_ENTRYPOINTS"
+_REPO_ROOT = pathlib.Path(__file__).resolve().parents[2]
+
+
+def cache_dir() -> pathlib.Path:
+    env = os.environ.get(CACHE_ENV)
+    return pathlib.Path(env) if env else _REPO_ROOT / ".cclint_cache"
+
+
+def entry_modules(ctx: LintContext) -> List[SourceFile]:
+    """Files whose module level assigns CCLINT_TRACE_ENTRYPOINTS (AST, not
+    text — a docstring mentioning the name must not opt a module in)."""
+    out = []
+    for src in ctx.parsed_files:
+        for node in src.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign):
+                targets = [node.target]
+            if any(
+                isinstance(t, ast.Name) and t.id == _REGISTRY_NAME
+                for t in targets
+            ):
+                out.append(src)
+                break
+    return out
+
+
+def _versions() -> str:
+    """jax/jaxlib versions WITHOUT importing them (metadata only): part of
+    the cache key, since a toolchain bump can change every verdict."""
+    from importlib import metadata
+
+    parts = []
+    for pkg in ("jax", "jaxlib"):
+        try:
+            parts.append(f"{pkg}={metadata.version(pkg)}")
+        except metadata.PackageNotFoundError:
+            parts.append(f"{pkg}=absent")
+    return ";".join(parts)
+
+
+def content_key(ctx: LintContext) -> str:
+    """sha256 over every linted source (rel path + bytes), the toolchain
+    versions, and the worker schema. Conservative by design: ANY source
+    edit in the linted set invalidates — tracing is cheap enough to redo
+    and a dependency-graph hash would miss transitive kernel imports."""
+    h = hashlib.sha256()
+    h.update(f"schema={WORKER_SCHEMA};{_versions()}".encode())
+    for src in sorted(ctx.files, key=lambda s: s.rel):
+        h.update(b"\x00")
+        h.update(src.rel.encode())
+        h.update(b"\x00")
+        h.update(src.text.encode())
+    return h.hexdigest()
+
+
+def _cache_load(key: str):
+    path = cache_dir() / f"trace-{key[:32]}.json"
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    if doc.get("key") != key or doc.get("version") != WORKER_SCHEMA:
+        return None
+    return doc
+
+
+def _cache_store(key: str, payload: Dict) -> None:
+    d = cache_dir()
+    try:
+        d.mkdir(parents=True, exist_ok=True)
+        tmp = d / f".trace-{key[:32]}.tmp.{os.getpid()}"
+        tmp.write_text(json.dumps(
+            {"key": key, "version": WORKER_SCHEMA, **payload}, indent=2,
+            sort_keys=True,
+        ))
+        tmp.replace(d / f"trace-{key[:32]}.json")
+    except OSError:
+        pass  # a read-only checkout still lints, it just re-traces
+
+
+def _spawn_worker(ctx: LintContext, mods: List[SourceFile]) -> Dict:
+    cmd = [
+        sys.executable, "-m", "cruise_control_tpu.lint.trace_worker",
+        "--root", str(ctx.root),
+    ] + [m.rel for m in mods]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        str(_REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    ).rstrip(os.pathsep)
+    timeout = float(os.environ.get(TIMEOUT_ENV, DEFAULT_TIMEOUT_S))
+    try:
+        proc = subprocess.run(
+            cmd, cwd=_REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return {"findings": [
+            {
+                "rule": "trace-entry-error", "path": m.rel, "line": 1,
+                "message": f"trace worker did not run: {type(e).__name__}: "
+                           f"{str(e)[:200]}",
+            }
+            for m in mods
+        ], "stats": {"workerError": str(e)[:200]}}
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-6:]
+        return {"findings": [
+            {
+                "rule": "trace-entry-error", "path": m.rel, "line": 1,
+                "message": f"trace worker exited {proc.returncode}: "
+                           + " | ".join(tail)[:300],
+            }
+            for m in mods
+        ], "stats": {"workerError": f"rc={proc.returncode}"}}
+    try:
+        doc = json.loads(proc.stdout)
+    except ValueError:
+        return {"findings": [
+            {
+                "rule": "trace-entry-error", "path": m.rel, "line": 1,
+                "message": "trace worker produced unparseable output: "
+                           + proc.stdout[:200],
+            }
+            for m in mods
+        ], "stats": {"workerError": "bad-json"}}
+    return {"findings": doc.get("findings", []), "stats": doc.get("stats", {})}
+
+
+def trace_payload(ctx: LintContext) -> Dict:
+    """The shared per-context trace verdict: computed once, memoized in
+    ctx.cache for the run and on disk (content-hash keyed) across runs."""
+    cached = ctx.cache.get("trace-payload")
+    if cached is not None:
+        return cached
+    mods = entry_modules(ctx)
+    if not mods:
+        payload = {"findings": [], "stats": {"entryPoints": 0, "modules": 0},
+                   "cacheHit": False, "skipped": True}
+        ctx.cache["trace-payload"] = payload
+        ctx.cache["trace-stats"] = _public_stats(payload)
+        return payload
+    key = content_key(ctx)
+    doc = _cache_load(key)
+    if doc is not None:
+        CACHE_STATS["hits"] += 1
+        payload = {"findings": doc["findings"], "stats": doc.get("stats", {}),
+                   "cacheHit": True, "skipped": False}
+    else:
+        CACHE_STATS["misses"] += 1
+        fresh = _spawn_worker(ctx, mods)
+        if "workerError" not in fresh.get("stats", {}):
+            _cache_store(key, fresh)
+        payload = {**fresh, "cacheHit": False, "skipped": False}
+    ctx.cache["trace-payload"] = payload
+    ctx.cache["trace-stats"] = _public_stats(payload)
+    return payload
+
+
+def _public_stats(payload: Dict) -> Dict:
+    """The `trace` block of the --json schema."""
+    stats = payload.get("stats", {})
+    return {
+        "cacheHit": payload.get("cacheHit", False),
+        "skipped": payload.get("skipped", False),
+        "entryPoints": stats.get("entryPoints", 0),
+        "modules": stats.get("modules", 0),
+        "workerWallS": stats.get("wallS", 0.0),
+    }
+
+
+class TraceRule(Rule):
+    """Shared driver: each rule yields its slice of the worker's findings.
+    The first trace rule to run pays (or cache-loads) the shared payload."""
+
+    family = "trace"
+    tier = "trace"
+
+    def check(self, ctx: LintContext) -> Iterator[Finding]:
+        for f in trace_payload(ctx)["findings"]:
+            if f["rule"] == self.id:
+                yield Finding(
+                    rule=self.id, path=f["path"], line=int(f["line"]),
+                    message=f["message"],
+                )
+
+
+@register
+class HostCallbackRule(TraceRule):
+    id = "trace-host-callback"
+    rationale = (
+        "a pure/debug/io_callback primitive under a jit boundary is a host "
+        "round-trip inside traced code — invisible to token rules when "
+        "buried in a helper, fatal to the fused-round dispatch budget"
+    )
+
+
+@register
+class DonationIntegrityRule(TraceRule):
+    id = "trace-donation-integrity"
+    rationale = (
+        "a donate_argnums buffer with no same-shape/dtype output to alias "
+        "into is a dead donation: the caller lost the buffer and XLA reused "
+        "nothing — the class the tpu.donate.model.buffers reservation guards"
+    )
+
+
+@register
+class CarryStabilityRule(TraceRule):
+    id = "trace-carry-stability"
+    rationale = (
+        "while/scan carries must be shape/dtype/pytree-stable with no "
+        "weak_type or float64 avals, or the ROADMAP-1 fused round loop "
+        "forks compiled programs out of the PR-3 shape-bucket ladder"
+    )
+
+
+@register
+class ConstantBloatRule(TraceRule):
+    id = "trace-constant-bloat"
+    rationale = (
+        "a closure-captured array baked into program constants ships with "
+        "every compiled program in the bucket ladder and silently pins "
+        "device memory; big operands must arrive as arguments"
+    )
+
+
+@register
+class ShardingLoweringRule(TraceRule):
+    id = "trace-sharding-lowering"
+    rationale = (
+        "sharded entry points must lower and compile under the virtual "
+        "8-device partition mesh without ops that force the sharded axis "
+        "to replicate (psum is the intended collective, PAPER.md) — the "
+        "gate the tpu.mesh.axis.name reservation's ROADMAP-2 work must pass"
+    )
+
+
+@register
+class EntryErrorRule(TraceRule):
+    id = "trace-entry-error"
+    rationale = (
+        "an entry-point registry that fails to import, build, or trace is "
+        "a kernel surface no trace rule certifies — equivalent to "
+        "lint-parse-error one tier up"
+    )

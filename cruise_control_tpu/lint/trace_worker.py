@@ -1,0 +1,451 @@
+"""cclint trace-tier worker: abstract evaluation of registered kernel entry
+points, run in a SUBPROCESS so the parent linter never imports JAX.
+
+The token rules see source tokens and ASTs; everything they cannot see — a
+host callback buried three calls under a jit boundary, a donated buffer
+with no output to alias into, a `weak_type` carry that would fork a
+compiled program out of its shape bucket — is visible in the jaxpr. This
+worker loads every module that declares a `CCLINT_TRACE_ENTRYPOINTS`
+registry (lint/entrypoints.py for the package; trace-rule fixtures declare
+their own), builds each entry's callable and example arguments, traces it
+with `jax.make_jaxpr`, and walks the closed jaxpr recursively. Sharded
+entries are additionally lowered AND compiled under a virtual 8-device mesh
+(the process pins `--xla_force_host_platform_device_count` before JAX
+initializes — same mechanism as the multichip dryrun, platform_probe).
+
+Findings are attributed to the LINE of the entry's `name="..."` declaration
+in the registry module, so the normal suppression syntax works there:
+
+    dict(name="noisy-entry", build=_b),  # cclint: disable=trace-constant-bloat -- reason
+
+Protocol: `python -m cruise_control_tpu.lint.trace_worker --root R rel.py...`
+prints one JSON document: {"version", "findings": [{rule, path, line,
+message}], "stats": {...}}. rules_trace.py caches that document keyed by
+the content hash of the linted sources, so the tracing cost is paid once
+per source state.
+
+Entry registry protocol (plain dicts — fixtures need no package imports):
+
+    CCLINT_TRACE_ENTRYPOINTS = [
+        dict(name="my-kernel", build=_build),   # one entry per line
+    ]
+
+where `build()` returns a dict with keys:
+    fn              callable (plain or already-jitted)
+    args            tuple of example arguments (small concrete arrays)
+    donate_argnums  optional tuple — positions whose buffers the real call
+                    site donates (checked for dead donations)
+    shardings       optional per-arg PartitionSpec trees (tuples of axis
+                    names / None, or pytrees of those matching the arg);
+                    presence opts the entry into the sharded lower+compile
+    mesh_shape      optional ((axis, size), ...), default (("partitions", 8),)
+    max_all_gathers optional int, default 0 — compiled all-gather budget
+    const_bytes_limit optional int, default 65536 — baked-constant budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import importlib.util
+import json
+import pathlib
+import re
+import sys
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: bump when the check semantics change: the content-hash cache key
+#: includes this, so stale cached verdicts cannot survive a worker upgrade
+WORKER_SCHEMA = 3
+
+#: primitives that round-trip to the host from inside traced code
+CALLBACK_PRIMITIVES = ("pure_callback", "debug_callback", "io_callback")
+
+DEFAULT_MESH_SHAPE = (("partitions", 8),)
+DEFAULT_CONST_BYTES_LIMIT = 1 << 16
+
+#: trace errors that mean "the loop carry is not shape/dtype/pytree-stable"
+#: (jax refuses to trace them — which is exactly the fusibility violation)
+_CARRY_ERROR_RE = re.compile(
+    r"carry|body_fun output and input|while_loop|scan body", re.IGNORECASE
+)
+
+
+def _finding(rule: str, path: str, line: int, message: str) -> Dict:
+    return {"rule": rule, "path": path, "line": line, "message": message}
+
+
+def _entry_line(source_lines: List[str], name: str) -> int:
+    """The 1-based line declaring `name="<name>"` — the suppression anchor."""
+    pat = re.compile(r"""name\s*=\s*['"]""" + re.escape(name) + r"""['"]""")
+    for i, line in enumerate(source_lines, start=1):
+        if pat.search(line):
+            return i
+    return 1
+
+
+def _walk_jaxprs(jaxpr, seen: set):
+    """Yield every (sub)jaxpr eqn plus the ClosedJaxprs hiding in params."""
+    if id(jaxpr) in seen:
+        return
+    seen.add(id(jaxpr))
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for p in eqn.params.values():
+            items = p if isinstance(p, (list, tuple)) else [p]
+            for it in items:
+                inner = getattr(it, "jaxpr", None)
+                if inner is not None and hasattr(it, "consts"):  # ClosedJaxpr
+                    yield ("closed", it)
+                    yield from _walk_jaxprs(inner, seen)
+                elif hasattr(it, "eqns"):  # raw Jaxpr
+                    yield from _walk_jaxprs(it, seen)
+
+
+def _carry_avals(eqn) -> Iterable:
+    """The carry avals of a while/scan eqn (the fusibility contract ROADMAP-1
+    round fusion rides on: these must stay bucket-stable)."""
+    import jax  # noqa: F401 - the worker owns the jax import
+
+    if eqn.primitive.name == "while":
+        return [v.aval for v in eqn.params["body_jaxpr"].jaxpr.invars]
+    if eqn.primitive.name == "scan":
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        return [v.aval for v in eqn.params["jaxpr"].jaxpr.invars[nc:nc + ncar]]
+    return []
+
+
+def check_jaxpr(entry_name: str, closed, path: str, line: int,
+                const_bytes_limit: int) -> List[Dict]:
+    """The pure jaxpr walks: host callbacks, carry stability, constant bloat.
+    Importable in-process for unit tests — only `run()` pins the platform."""
+    import numpy as np
+
+    findings: List[Dict] = []
+    seen_consts = set()
+
+    def check_consts(consts, where: str):
+        for c in consts:
+            if id(c) in seen_consts:
+                continue
+            seen_consts.add(id(c))
+            nbytes = getattr(c, "nbytes", 0)
+            if nbytes > const_bytes_limit:
+                shape = tuple(np.shape(c))
+                findings.append(_finding(
+                    "trace-constant-bloat", path, line,
+                    f"entry `{entry_name}` bakes a {nbytes}-byte constant "
+                    f"(shape {shape}) into the compiled program (limit "
+                    f"{const_bytes_limit}); closure-captured arrays ship "
+                    "with every program in the bucket ladder — pass it as "
+                    "an argument instead",
+                ))
+
+    check_consts(closed.consts, "top")
+    seen: set = set()
+    for item in _walk_jaxprs(closed.jaxpr, seen):
+        if isinstance(item, tuple) and item[0] == "closed":
+            check_consts(item[1].consts, "inner")
+            continue
+        eqn = item
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES:
+            findings.append(_finding(
+                "trace-host-callback", path, line,
+                f"entry `{entry_name}` reaches a `{name}` primitive under "
+                "the jit boundary — a host round-trip inside traced code "
+                "serializes the device pipeline; hoist it to the host shell "
+                "or drop it",
+            ))
+        for aval in _carry_avals(eqn):
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and str(dtype) == "float64":
+                findings.append(_finding(
+                    "trace-carry-stability", path, line,
+                    f"entry `{entry_name}`: {name} carry holds a float64 "
+                    f"aval ({aval}) — a double-precision carry forks the "
+                    "compiled program out of its f32 shape bucket",
+                ))
+            if getattr(aval, "weak_type", False):
+                findings.append(_finding(
+                    "trace-carry-stability", path, line,
+                    f"entry `{entry_name}`: {name} carry holds a weak-typed "
+                    f"aval ({aval}) — seed the carry with explicit dtypes "
+                    "(jnp.int32/jnp.float32), or the same program retraces "
+                    "when a strongly-typed carry arrives",
+                ))
+    return findings
+
+
+def check_donation(entry_name: str, closed, args: tuple,
+                   donate_argnums: Tuple[int, ...], path: str,
+                   line: int) -> List[Dict]:
+    """Dead-donation check: every donated input leaf must find an output
+    leaf of identical shape/dtype to alias into (XLA's matching rule) —
+    otherwise the donation frees nothing and the caller merely lost the
+    buffer. Catches the class the `tpu.donate.model.buffers` reservation
+    exists for."""
+    import jax
+
+    pool: Dict[Tuple, int] = {}
+    for aval in closed.out_avals:
+        key = (tuple(aval.shape), str(aval.dtype))
+        pool[key] = pool.get(key, 0) + 1
+    findings: List[Dict] = []
+    for i in donate_argnums:
+        if i >= len(args):
+            findings.append(_finding(
+                "trace-donation-integrity", path, line,
+                f"entry `{entry_name}` declares donate_argnums position {i} "
+                f"but only {len(args)} example arguments",
+            ))
+            continue
+        for leaf in jax.tree_util.tree_leaves(args[i]):
+            key = (tuple(leaf.shape), str(leaf.dtype))
+            if pool.get(key, 0) > 0:
+                pool[key] -= 1
+            else:
+                findings.append(_finding(
+                    "trace-donation-integrity", path, line,
+                    f"entry `{entry_name}`: donated argument {i} holds a "
+                    f"{key[1]}{list(key[0])} buffer with no same-shape/dtype "
+                    "output to alias into — the donation is dead (the "
+                    "buffer is freed, nothing is reused); drop it from "
+                    "donate_argnums or return the updated buffer",
+                ))
+    return findings
+
+
+def _build_shardings(spec_tree, args, mesh):
+    """Per-arg PartitionSpec trees -> NamedSharding trees matching `args`."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def to_sharding(spec):
+        if spec is None:
+            return NamedSharding(mesh, PartitionSpec())
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    out = []
+    for spec, arg in zip(spec_tree, args):
+        if isinstance(spec, (tuple, list)) and all(
+            s is None or isinstance(s, str) for s in spec
+        ):
+            out.append(to_sharding(tuple(spec)))
+        else:
+            # a pytree of specs matching the arg's structure (NamedTuples)
+            out.append(jax.tree_util.tree_map(
+                to_sharding, spec,
+                is_leaf=lambda x: x is None or (
+                    isinstance(x, (tuple, list))
+                    and all(s is None or isinstance(s, str) for s in x)
+                ),
+            ))
+    return tuple(out)
+
+
+def check_sharding(entry_name: str, fn, args: tuple, spec_tree, mesh_shape,
+                   max_all_gathers: int, path: str, line: int) -> List[Dict]:
+    """Sharding-readiness: the entry must lower AND compile under a virtual
+    mesh with its declared PartitionSpecs, and the compiled program may not
+    gather the sharded axis back together more than its budget allows (the
+    PAPER.md target is vmap-scored moves reduced with `psum`: all-reduce is
+    the intended collective, an all-gather is replication)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    findings: List[Dict] = []
+    sizes = [s for _, s in mesh_shape]
+    need = int(np.prod(sizes))
+    devices = jax.devices()
+    if len(devices) < need:
+        findings.append(_finding(
+            "trace-sharding-lowering", path, line,
+            f"entry `{entry_name}` needs a {need}-device mesh but the worker "
+            f"sees {len(devices)} devices — virtual-device pinning failed",
+        ))
+        return findings
+    mesh = Mesh(
+        np.asarray(devices[:need]).reshape(sizes), tuple(a for a, _ in mesh_shape)
+    )
+    try:
+        in_shardings = _build_shardings(spec_tree, args, mesh)
+        jitted = jax.jit(fn, in_shardings=in_shardings)
+        compiled = jitted.lower(*args).compile()
+    except Exception as e:  # surface the lowering verdict, whatever its class
+        findings.append(_finding(
+            "trace-sharding-lowering", path, line,
+            f"entry `{entry_name}` fails to lower/compile under the "
+            f"{'x'.join(str(s) for s in sizes)} `"
+            f"{','.join(a for a, _ in mesh_shape)}` mesh: "
+            f"{type(e).__name__}: {str(e)[:300]}",
+        ))
+        return findings
+    hlo = compiled.as_text()
+    gathers = [
+        ln.strip() for ln in hlo.splitlines()
+        if "all-gather" in ln and "=" in ln and not ln.lstrip().startswith("//")
+    ]
+    if len(gathers) > max_all_gathers:
+        sample = gathers[0][:160] if gathers else ""
+        findings.append(_finding(
+            "trace-sharding-lowering", path, line,
+            f"entry `{entry_name}` compiles to {len(gathers)} all-gather "
+            f"op(s) under the mesh (budget {max_all_gathers}) — an op in "
+            "this entry forces the sharded axis to be replicated instead of "
+            f"psum-reduced; first: `{sample}`",
+        ))
+    return findings
+
+
+def analyze_entry(entry: Dict, path: str, line: int) -> Tuple[List[Dict], Dict]:
+    """All checks for one built entry. Returns (findings, stats)."""
+    import jax
+
+    name = entry["name"]
+    subject = entry["build"]()
+    fn = subject["fn"]
+    args = tuple(subject.get("args", ()))
+    donate = tuple(subject.get("donate_argnums", ()))
+    spec_tree = subject.get("shardings")
+    stats = {"name": name, "traceS": 0.0}
+    findings: List[Dict] = []
+    t0 = time.monotonic()
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as e:
+        msg = str(e)
+        rule = (
+            "trace-carry-stability"
+            if _CARRY_ERROR_RE.search(msg)
+            else "trace-entry-error"
+        )
+        detail = (
+            "loop carry is not shape/dtype/pytree-stable across iterations "
+            "(round fusion cannot hold this program in one bucket): "
+            if rule == "trace-carry-stability" else "cannot trace: "
+        )
+        findings.append(_finding(
+            rule, path, line,
+            f"entry `{name}` {detail}{type(e).__name__}: {msg[:300]}",
+        ))
+        stats["traceS"] = round(time.monotonic() - t0, 3)
+        return findings, stats
+    stats["traceS"] = round(time.monotonic() - t0, 3)
+    findings.extend(check_jaxpr(
+        name, closed, path, line,
+        int(subject.get("const_bytes_limit", DEFAULT_CONST_BYTES_LIMIT)),
+    ))
+    if donate:
+        findings.extend(check_donation(name, closed, args, donate, path, line))
+    if spec_tree is not None:
+        findings.extend(check_sharding(
+            name, fn, args, spec_tree,
+            tuple(subject.get("mesh_shape", DEFAULT_MESH_SHAPE)),
+            int(subject.get("max_all_gathers", 0)), path, line,
+        ))
+    return findings, stats
+
+
+def load_entry_modules(root: pathlib.Path, rels: List[str]):
+    """Import each registry module by file path; yield (rel, module_or_error)."""
+    for rel in rels:
+        full = root / rel
+        modname = "cclint_trace_" + hashlib.sha1(rel.encode()).hexdigest()[:10]
+        try:
+            spec = importlib.util.spec_from_file_location(modname, full)
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[modname] = mod  # entries may self-reference on import
+            spec.loader.exec_module(mod)
+            yield rel, mod, None
+        except Exception as e:
+            yield rel, None, f"{type(e).__name__}: {str(e)[:300]}"
+
+
+def run(root: pathlib.Path, rels: List[str]) -> Dict:
+    t_start = time.monotonic()
+    findings: List[Dict] = []
+    entry_stats: List[Dict] = []
+    for rel, mod, err in load_entry_modules(root, rels):
+        if err is not None:
+            findings.append(_finding(
+                "trace-entry-error", rel, 1,
+                f"entry-point module failed to import: {err}",
+            ))
+            continue
+        entries = getattr(mod, "CCLINT_TRACE_ENTRYPOINTS", None)
+        if not isinstance(entries, (list, tuple)):
+            findings.append(_finding(
+                "trace-entry-error", rel, 1,
+                "CCLINT_TRACE_ENTRYPOINTS must be a list of "
+                "dict(name=..., build=...) entries",
+            ))
+            continue
+        lines = (root / rel).read_text().splitlines()
+        for entry in entries:
+            name = entry.get("name") if isinstance(entry, dict) else None
+            if not name or not callable(entry.get("build")):
+                findings.append(_finding(
+                    "trace-entry-error", rel, 1,
+                    f"malformed registry entry {entry!r}: needs a `name` "
+                    "string and a callable `build`",
+                ))
+                continue
+            line = _entry_line(lines, name)
+            try:
+                fs, st = analyze_entry(entry, rel, line)
+            except Exception as e:
+                fs = [_finding(
+                    "trace-entry-error", rel, line,
+                    f"entry `{name}` build() failed: {type(e).__name__}: "
+                    f"{str(e)[:300]}",
+                )]
+                st = {"name": name, "traceS": 0.0}
+            # dedup identical findings within one entry: the unrolled stack
+            # repeats each goal body per phase, so a single kernel violation
+            # would otherwise print once per unroll copy
+            seen_f = set()
+            for f in fs:
+                key = (f["rule"], f["line"], f["message"])
+                if key not in seen_f:
+                    seen_f.add(key)
+                    findings.append(f)
+            entry_stats.append(st)
+    return {
+        "version": WORKER_SCHEMA,
+        "findings": findings,
+        "stats": {
+            "modules": len(rels),
+            "entryPoints": len(entry_stats),
+            "entries": entry_stats,
+            "wallS": round(time.monotonic() - t_start, 3),
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="cclint-trace-worker")
+    parser.add_argument("--root", type=pathlib.Path, required=True)
+    parser.add_argument("rels", nargs="+")
+    args = parser.parse_args(argv)
+
+    # pin BEFORE jax initializes: the sharding checks need the virtual
+    # 8-device mesh, and a dead TPU tunnel must not hang the linter
+    from cruise_control_tpu.platform_probe import pin_cpu
+
+    need = max(
+        (s for _, s in DEFAULT_MESH_SHAPE), default=8
+    )
+    pin_cpu(device_count=max(8, need))
+
+    doc = run(args.root.resolve(), list(args.rels))
+    json.dump(doc, sys.stdout)
+    sys.stdout.write("\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

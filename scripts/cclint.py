@@ -1,13 +1,18 @@
 #!/usr/bin/env python3
 """cclint CLI wrapper: lint the package without installing it.
 
-    python scripts/cclint.py                 # full package, human output
-    python scripts/cclint.py --json          # machine output (CI)
+    python scripts/cclint.py                 # full package, both tiers
+    python scripts/cclint.py --tier token    # ast/text rules only (fast loop)
+    python scripts/cclint.py --tier trace    # jaxpr-level entry-point rules
+    python scripts/cclint.py --json          # machine output, schema v2 (CI)
     python scripts/cclint.py --changed-only  # only files differing from main
     python scripts/cclint.py --list-rules    # rule catalog
 
-Rule catalog and suppression policy: docs/LINTING.md. The same run gates
-tier-1 through tests/test_static_guards.py.
+This is the SAME CLI as `python -m cruise_control_tpu.lint` (pinned by
+tests/test_lint_trace.py). Rule catalog and suppression policy:
+docs/LINTING.md. The same run gates tier-1 through
+tests/test_static_guards.py; the trace tier's verdicts are cached under
+.cclint_cache/ keyed by source content hash.
 """
 
 import pathlib

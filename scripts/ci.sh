@@ -1,8 +1,14 @@
 #!/usr/bin/env bash
-# One CI entrypoint: cclint -> tier-1 tests -> perf gate.
+# One CI entrypoint: cclint (token + trace tiers) -> tier-1 tests -> perf gate.
 #
 # Usage:
 #   scripts/ci.sh [CANDIDATE_BENCH_DETAIL.json]
+#
+# Artifacts: every run archives the cclint --json report (schema v2:
+# per-rule family/tier/wall-time plus the trace-cache verdict) NEXT TO the
+# tier-1 test log under $CI_ARTIFACTS (default /tmp/cruise_ci_artifacts):
+#   cclint_report.json   machine-readable lint verdict
+#   tier1.log            full tier-1 pytest output
 #
 # The perf gate only runs when a candidate BENCH_DETAIL.json is given (a
 # fresh bench run is minutes of wall-clock; CI stages it separately and
@@ -21,12 +27,36 @@
 set -u
 cd "$(dirname "$0")/.."
 
-echo "== cclint =="
-python scripts/cclint.py || exit 1
+ART="${CI_ARTIFACTS:-/tmp/cruise_ci_artifacts}"
+mkdir -p "$ART"
 
-echo "== tier-1 tests =="
+echo "== cclint (token + trace tiers) =="
+python scripts/cclint.py --tier all --json > "$ART/cclint_report.json"
+lint_rc=$?
+python - "$ART/cclint_report.json" <<'PY'
+import json, sys
+try:
+    doc = json.load(open(sys.argv[1]))
+except Exception as e:  # report unreadable: the exit code still gates
+    print(f"cclint report unreadable: {e}")
+    raise SystemExit(0)
+s = doc.get("summary", {})
+tr = doc.get("trace", {})
+print(f"cclint: {s.get('unsuppressed', '?')} open / {s.get('suppressed', '?')} "
+      f"suppressed over {doc.get('numFiles', '?')} files; trace tier: "
+      f"{tr.get('entryPoints', 0)} entry points, "
+      f"{'cache hit' if tr.get('cacheHit') else 'traced fresh'}")
+for f in doc.get("findings", []):
+    if not f.get("suppressed"):
+        print(f"  {f['path']}:{f['line']}: {f['rule']}  {f['message']}")
+PY
+[ $lint_rc -eq 0 ] || exit 1
+
+echo "== tier-1 tests (log: $ART/tier1.log) =="
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
-    --continue-on-collection-errors -p no:cacheprovider || exit 2
+    --continue-on-collection-errors -p no:cacheprovider 2>&1 \
+    | tee "$ART/tier1.log"
+[ "${PIPESTATUS[0]}" -eq 0 ] || exit 2
 
 if [ $# -ge 1 ]; then
     echo "== perf gate =="
@@ -41,4 +71,4 @@ if [ $# -ge 1 ]; then
     esac
 fi
 
-echo "ci: all stages passed"
+echo "ci: all stages passed (artifacts: $ART)"

@@ -1,0 +1,25 @@
+"""Clean fixture: the same loop with an explicitly-typed, shape-stable
+carry — exactly the carry contract the fused round loop needs."""
+
+
+def _kernel(x):
+    import jax
+    import jax.numpy as jnp
+
+    c = jax.lax.while_loop(
+        lambda c: c < jnp.float32(3.0),
+        lambda c: c + jnp.float32(1.0),
+        jnp.zeros((), jnp.float32),
+    )
+    return x + c
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="stable-carry-kernel", build=_build),
+]

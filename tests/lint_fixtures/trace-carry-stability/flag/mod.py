@@ -1,0 +1,41 @@
+"""Flag fixture: two carry hazards — a weak-typed while carry (Python
+literal seed: the program retraces the moment a strongly-typed carry
+arrives, forking its shape bucket) and a shape-drifting scan carry (jax
+refuses to trace it, which IS the fusibility violation)."""
+
+
+def _weak_carry_kernel(x):
+    import jax
+
+    # 0.0 / 1.0 literals keep the carry weak_type all the way through
+    c = jax.lax.while_loop(lambda c: c < 3.0, lambda c: c + 1.0, 0.0)
+    return x + c
+
+
+def _drifting_carry_kernel(x):
+    import jax
+    import jax.numpy as jnp
+
+    def body(c, _):
+        return jnp.concatenate([c, c]), ()  # carry doubles every step
+
+    c, _ = jax.lax.scan(body, x, None, length=3)
+    return c
+
+
+def _build_weak():
+    import jax.numpy as jnp
+
+    return dict(fn=_weak_carry_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+def _build_drift():
+    import jax.numpy as jnp
+
+    return dict(fn=_drifting_carry_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="weak-carry-kernel", build=_build_weak),
+    dict(name="drifting-carry-kernel", build=_build_drift),
+]

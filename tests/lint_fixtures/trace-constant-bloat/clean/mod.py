@@ -1,0 +1,24 @@
+"""Clean fixture: the big operand arrives as an ARGUMENT — no captured
+constant, the program stays constant-lean at any scale."""
+
+
+def _kernel(x, table):
+    return x + table.sum()
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(
+        fn=_kernel,
+        args=(
+            jnp.zeros((4,), jnp.float32),
+            jnp.arange(1024, dtype=jnp.float32),
+        ),
+        const_bytes_limit=1024,
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="argument-operand-kernel", build=_build),
+]

@@ -1,0 +1,22 @@
+"""Flag fixture: a closure-captured device array baked into the program as
+a constant, above the entry's declared budget."""
+
+
+def _build():
+    import jax.numpy as jnp
+
+    baked = jnp.arange(1024, dtype=jnp.float32)  # 4 KiB captured constant
+
+    def _kernel(x):
+        return x + baked.sum()
+
+    return dict(
+        fn=_kernel,
+        args=(jnp.zeros((4,), jnp.float32),),
+        const_bytes_limit=1024,
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="baked-constant-kernel", build=_build),
+]

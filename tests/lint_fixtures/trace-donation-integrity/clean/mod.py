@@ -1,0 +1,23 @@
+"""Clean fixture: the donated buffer has a same-shape/dtype output to alias
+into (the updated state comes back out), so XLA can reuse its memory."""
+
+
+def _kernel(x):
+    import jax.numpy as jnp
+
+    return x + 1.0, jnp.sum(x)  # x2 aliases the donated x
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(
+        fn=_kernel,
+        args=(jnp.zeros((4,), jnp.float32),),
+        donate_argnums=(0,),
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="aliased-donation-kernel", build=_build),
+]

@@ -1,0 +1,23 @@
+"""Flag fixture: the caller donates a f32[4] buffer but the kernel only
+returns a scalar — the donation is dead (nothing aliases the buffer)."""
+
+
+def _kernel(x):
+    import jax.numpy as jnp
+
+    return jnp.sum(x)  # f32[] output: no home for the donated f32[4]
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(
+        fn=_kernel,
+        args=(jnp.zeros((4,), jnp.float32),),
+        donate_argnums=(0,),
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="dead-donation-kernel", build=_build),
+]

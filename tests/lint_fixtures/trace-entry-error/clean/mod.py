@@ -1,0 +1,16 @@
+"""Clean fixture: a well-formed registry whose entry builds and traces."""
+
+
+def _kernel(x):
+    return x + 1
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="healthy-entry", build=_build),
+]

@@ -1,0 +1,11 @@
+"""Flag fixture: a registry whose build() raises — a kernel surface no
+trace rule can certify must itself be a finding, not a silent skip."""
+
+
+def _build():
+    raise RuntimeError("broken registry entry: model generator unavailable")
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="broken-entry", build=_build),
+]

@@ -1,0 +1,17 @@
+"""Clean fixture: the same kernel shape with the callback removed — pure
+on-device math traces to a callback-free jaxpr."""
+
+
+def _kernel(x):
+    return x * 2
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="callback-free-kernel", build=_build),
+]

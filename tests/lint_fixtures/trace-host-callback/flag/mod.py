@@ -1,0 +1,20 @@
+"""Flag fixture: a debug callback buried inside the jitted kernel — a host
+round-trip the token rules cannot see (no `.item()`, no `np.asarray`)."""
+
+
+def _kernel(x):
+    import jax
+
+    jax.debug.callback(lambda v: None, x)  # host round-trip under jit
+    return x * 2
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="callback-kernel", build=_build),
+]

@@ -1,0 +1,28 @@
+"""Clean fixture: a per-row kernel on a divisible axis — lowers and
+compiles under the 8-device mesh with zero all-gathers (each shard scores
+its rows against the replicated weights, the PAPER.md recipe)."""
+
+
+def _kernel(x, w):
+    import jax.numpy as jnp
+
+    return jnp.sum(x * w[None, :], axis=1)
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(
+        fn=_kernel,
+        args=(
+            jnp.zeros((16, 4), jnp.float32),
+            jnp.zeros((4,), jnp.float32),
+        ),
+        shardings=(("partitions", None), None),
+        max_all_gathers=0,
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="shard-ready-kernel", build=_build),
+]

@@ -1,0 +1,50 @@
+"""Flag fixture: two sharding-readiness failures — a partition axis the
+8-device mesh cannot divide, and a kernel whose global argsort-gather
+forces the sharded axis to be all-gathered (replicated) at compile time."""
+
+
+def _rowwise_kernel(x, w):
+    import jax.numpy as jnp
+
+    return jnp.sum(x * w[None, :], axis=1)
+
+
+def _gather_kernel(x, w):
+    import jax.numpy as jnp
+
+    order = jnp.argsort(x[:, 0])  # global sort across the sharded axis
+    return x[order] * w[None, :]
+
+
+def _build_indivisible():
+    import jax.numpy as jnp
+
+    # 12 rows over an 8-way mesh: the PartitionSpec cannot apply
+    return dict(
+        fn=_rowwise_kernel,
+        args=(
+            jnp.zeros((12, 4), jnp.float32),
+            jnp.zeros((4,), jnp.float32),
+        ),
+        shardings=(("partitions", None), None),
+    )
+
+
+def _build_replicating():
+    import jax.numpy as jnp
+
+    return dict(
+        fn=_gather_kernel,
+        args=(
+            jnp.zeros((16, 4), jnp.float32),
+            jnp.zeros((4,), jnp.float32),
+        ),
+        shardings=(("partitions", None), None),
+        max_all_gathers=0,
+    )
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="indivisible-axis-kernel", build=_build_indivisible),
+    dict(name="replication-forcing-kernel", build=_build_replicating),
+]

@@ -25,6 +25,7 @@ from cruise_control_tpu.lint import (
     render_human,
     render_json,
     run_rules,
+    tier_rules,
     unsuppressed,
 )
 from cruise_control_tpu.lint.cli import main as cclint_main
@@ -49,13 +50,21 @@ class TestRuleCatalog:
         real = [r for r in all_rules() if r.family != "lint"]
         assert len(real) >= 10, [r.id for r in real]
 
-    def test_three_families_shipped(self):
+    def test_four_families_shipped(self):
         families = {r.family for r in all_rules()}
-        assert {"tpu", "concurrency", "registry"} <= families
+        assert {"tpu", "concurrency", "registry", "trace"} <= families
 
-    def test_every_rule_has_id_family_rationale(self):
+    def test_every_rule_has_id_family_tier_rationale(self):
         for r in all_rules():
             assert r.id and r.family and r.rationale, r
+            assert r.tier in ("token", "trace"), r.id
+
+    def test_tier_selection_partitions_the_registry(self):
+        token = {r.id for r in tier_rules("token")}
+        trace = {r.id for r in tier_rules("trace")}
+        assert token and trace and not (token & trace)
+        assert token | trace == {r.id for r in tier_rules("all")}
+        assert all(rid.startswith("trace-") for rid in trace)
 
 
 @pytest.mark.parametrize("rule_id", RULE_IDS)
@@ -147,18 +156,37 @@ class TestSuppressions:
 
 
 class TestOutput:
-    def test_json_schema(self, tmp_path):
+    def test_json_schema_v2(self, tmp_path):
         (tmp_path / "mod.py").write_text("def f(g):\n    while True:\n        g()\n")
         ctx = build_context(tmp_path)
+        timings = {}
         findings = run_rules(ctx, rules=[RULES["conc-unbounded-loop"]],
-                             check_unused=False)
+                             check_unused=False, timings=timings)
         doc = json.loads(render_json(findings, len(ctx.files),
-                                     ["conc-unbounded-loop"]))
-        assert doc["version"] == 1
+                                     [RULES["conc-unbounded-loop"]],
+                                     timings=timings))
+        assert doc["version"] == 2
         assert doc["summary"]["unsuppressed"] == 1
         assert doc["summary"]["byRule"] == {"conc-unbounded-loop": 1}
+        (rule_row,) = doc["rules"]
+        assert rule_row["id"] == "conc-unbounded-loop"
+        assert rule_row["family"] == "concurrency"
+        assert rule_row["tier"] == "token"
+        assert rule_row["wallMs"] >= 0.0
         (f,) = doc["findings"]
         assert f["rule"] == "conc-unbounded-loop" and f["path"] == "mod.py"
+
+    def test_json_trace_block(self, tmp_path):
+        (tmp_path / "ok.py").write_text("X = 1\n")
+        ctx = build_context(tmp_path)
+        rules = tier_rules("all")
+        findings = run_rules(ctx, rules=rules)
+        doc = json.loads(render_json(findings, len(ctx.files), rules,
+                                     trace_stats=ctx.cache.get("trace-stats")))
+        # no entry-point registry in the tree: the trace tier reports itself
+        # as skipped rather than silently absent
+        assert doc["trace"]["skipped"] is True
+        assert doc["trace"]["entryPoints"] == 0
 
     def test_human_output_mentions_path_line_rule(self, tmp_path):
         (tmp_path / "mod.py").write_text("def f(g):\n    while True:\n        g()\n")
@@ -220,6 +248,118 @@ class TestCli:
         assert rc == EXIT_FINDINGS
         doc = json.loads(capsys.readouterr().out)
         assert set(doc["summary"]["byRule"]) == {"conc-bare-except"}
+
+    def test_tier_token_selects_only_token_rules(self, tmp_path, capsys):
+        (tmp_path / "ok.py").write_text("X = 1\n")
+        rc = cclint_main(["--root", str(tmp_path), "--tier", "token", "--json"])
+        assert rc == EXIT_CLEAN
+        doc = json.loads(capsys.readouterr().out)
+        tiers = {r["tier"] for r in doc["rules"]}
+        assert tiers == {"token"}
+
+    def test_tier_trace_selects_only_trace_rules(self, tmp_path, capsys):
+        # no entry-point registry in the tree: the tier no-ops clean without
+        # ever spawning the tracing worker
+        (tmp_path / "ok.py").write_text("X = 1\n")
+        rc = cclint_main(["--root", str(tmp_path), "--tier", "trace", "--json"])
+        assert rc == EXIT_CLEAN
+        doc = json.loads(capsys.readouterr().out)
+        assert {r["tier"] for r in doc["rules"]} == {"trace"}
+        assert doc["trace"]["skipped"] is True
+
+
+def _tmp_git_repo(tmp_path, body: str):
+    import subprocess
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True)
+
+    (tmp_path / "mod.py").write_text(body)
+    git("init", "-q", "-b", "main")
+    git("config", "user.email", "lint@test")
+    git("config", "user.name", "lint")
+    git("add", "mod.py")
+    git("commit", "-qm", "seed")
+
+
+class TestChangedOnlyStaleSuppressions:
+    """Stale suppressions must not survive incremental CI: a partial
+    (`--rule`/`--tier`) `--changed-only` run judges staleness for the rules
+    it ran, scoped to the changed file set."""
+
+    STALE = (
+        "def f(g):\n"
+        "    try:\n"
+        "        return g()\n"
+        "    except ValueError:  # cclint: disable=conc-bare-except -- no longer bare\n"
+        "        return None\n"
+    )
+
+    def test_rule_filtered_changed_only_flags_stale(self, tmp_path, capsys):
+        _tmp_git_repo(tmp_path, self.STALE)
+        # touch the file so it enters the changed set
+        (tmp_path / "mod.py").write_text(self.STALE + "# touched\n")
+        rc = cclint_main(["--root", str(tmp_path), "--changed-only",
+                          "--rule", "conc-bare-except", "--json"])
+        assert rc == EXIT_FINDINGS
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["byRule"] == {"lint-unused-suppression": 1}
+
+    def test_tier_token_changed_only_flags_stale(self, tmp_path, capsys):
+        _tmp_git_repo(tmp_path, self.STALE)
+        (tmp_path / "mod.py").write_text(self.STALE + "# touched\n")
+        rc = cclint_main(["--root", str(tmp_path), "--changed-only",
+                          "--tier", "token", "--json"])
+        assert rc == EXIT_FINDINGS
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["byRule"].get("lint-unused-suppression") == 1
+
+    def test_unchanged_file_stays_out_of_changed_only_report(self, tmp_path,
+                                                             capsys):
+        _tmp_git_repo(tmp_path, self.STALE)
+        (tmp_path / "other.py").write_text("X = 1\n")  # the only change
+        rc = cclint_main(["--root", str(tmp_path), "--changed-only",
+                          "--rule", "conc-bare-except", "--json"])
+        assert rc == EXIT_CLEAN
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["byRule"] == {}
+
+    def test_live_suppression_not_flagged_by_partial_run(self, tmp_path,
+                                                         capsys):
+        live = (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except:  # cclint: disable=conc-bare-except -- fixture\n"
+            "        return None\n"
+        )
+        _tmp_git_repo(tmp_path, live)
+        (tmp_path / "mod.py").write_text(live + "# touched\n")
+        rc = cclint_main(["--root", str(tmp_path), "--changed-only",
+                          "--rule", "conc-bare-except", "--json"])
+        assert rc == EXIT_CLEAN
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["summary"]["byRule"] == {}
+
+    def test_unknown_rule_id_suppression_always_stale(self, tmp_path, capsys):
+        typo = (
+            "def f(g):\n"
+            "    try:\n"
+            "        return g()\n"
+            "    except:  # cclint: disable=conc-bare-excep -- typo'd id\n"
+            "        return None\n"
+        )
+        _tmp_git_repo(tmp_path, typo)
+        rc = cclint_main(["--root", str(tmp_path), "--rule",
+                          "conc-bare-except", "--json"])
+        assert rc == EXIT_FINDINGS
+        doc = json.loads(capsys.readouterr().out)
+        # the typo'd suppression is inert (real finding unsuppressed) AND
+        # flagged stale even on this partial run — an id no registry knows
+        # can never be judged live by any tier
+        assert doc["summary"]["byRule"]["conc-bare-except"] == 1
+        assert doc["summary"]["byRule"]["lint-unused-suppression"] == 1
 
 
 class TestKernelScoping:

@@ -1,0 +1,344 @@
+"""Trace-tier tests: the content-hash cache (hit/miss/invalidation on
+edit, corrupt-entry and schema-bump misses), the worker's check functions
+driven in-process on hand-built jaxprs, suppression anchoring at the
+registry declaration line, and the shared-CLI exit-code identity contract
+(`python -m cruise_control_tpu.lint` == `scripts/cclint.py`).
+
+The companion <10 s full-package budget assertion (the PR-6 contract,
+cache-warm, both tiers) lives in tests/test_static_guards.py
+::test_cclint_full_package_clean, next to the package-clean gate it
+qualifies. The subprocess-spawning cases here each cost one small JAX
+import (~1 s) and are consolidated to keep the module's tier-1 share
+flat; the package-scale trace itself is exercised once by
+test_static_guards and served from cache everywhere else."""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from cruise_control_tpu.lint import build_context, run_rules, tier_rules
+from cruise_control_tpu.lint.cli import main as cclint_main
+from cruise_control_tpu.lint import rules_trace
+from cruise_control_tpu.lint.rules_trace import (
+    CACHE_STATS,
+    content_key,
+    entry_modules,
+    trace_payload,
+)
+from cruise_control_tpu.lint.trace_worker import (
+    WORKER_SCHEMA,
+    check_donation,
+    check_jaxpr,
+    _entry_line,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+
+TINY_ENTRY = '''\
+"""Tiny trace entry: traces in milliseconds once jax is up."""
+
+
+def _kernel(x):
+    return x * 2
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="tiny-kernel", build=_build),
+]
+'''
+
+CALLBACK_ENTRY = '''\
+def _kernel(x):
+    import jax
+
+    jax.debug.callback(lambda v: None, x)
+    return x * 2
+
+
+def _build():
+    import jax.numpy as jnp
+
+    return dict(fn=_kernel, args=(jnp.zeros((4,), jnp.float32),))
+
+
+CCLINT_TRACE_ENTRYPOINTS = [
+    dict(name="noisy-kernel", build=_build),{suffix}
+]
+'''
+
+
+@pytest.fixture
+def trace_cache(tmp_path, monkeypatch):
+    """Point the on-disk cache at a throwaway dir; counters are
+    process-global, so tests assert on _stats_delta only."""
+    cache = tmp_path / "cache"
+    monkeypatch.setenv(rules_trace.CACHE_ENV, str(cache))
+    return cache
+
+
+def _stats_delta(fn):
+    before = dict(CACHE_STATS)
+    out = fn()
+    return out, {k: CACHE_STATS[k] - before[k] for k in CACHE_STATS}
+
+
+class TestDiscovery:
+    def test_assignment_opts_a_module_in(self, tmp_path):
+        (tmp_path / "mod.py").write_text(TINY_ENTRY)
+        ctx = build_context(tmp_path)
+        assert [m.rel for m in entry_modules(ctx)] == ["mod.py"]
+
+    def test_docstring_mention_does_not_opt_in(self, tmp_path):
+        (tmp_path / "mod.py").write_text(
+            '"""Docs may mention CCLINT_TRACE_ENTRYPOINTS = [...] freely."""\n'
+            "X = 1\n"
+        )
+        ctx = build_context(tmp_path)
+        assert entry_modules(ctx) == []
+
+    def test_no_entry_modules_skips_without_spawning(self, tmp_path,
+                                                     trace_cache):
+        (tmp_path / "mod.py").write_text("X = 1\n")
+        ctx = build_context(tmp_path)
+        payload, delta = _stats_delta(lambda: trace_payload(ctx))
+        assert payload["skipped"] is True and payload["findings"] == []
+        assert delta == {"hits": 0, "misses": 0}
+        assert not trace_cache.exists()  # nothing was traced, nothing cached
+
+
+class TestCache:
+    def test_cache_lifecycle(self, tmp_path, trace_cache, monkeypatch):
+        """One sequential story, four spawns: cold miss -> warm hit ->
+        invalidation on edit -> corrupt entry re-traced -> worker schema
+        bump re-traced. Sequenced (not split per case) so tier-1 pays the
+        worker's JAX import as few times as possible."""
+        (tmp_path / "mod.py").write_text(TINY_ENTRY)
+
+        _, d1 = _stats_delta(lambda: trace_payload(build_context(tmp_path)))
+        assert d1 == {"hits": 0, "misses": 1}
+
+        p2, d2 = _stats_delta(lambda: trace_payload(build_context(tmp_path)))
+        assert d2 == {"hits": 1, "misses": 0}
+        assert p2["cacheHit"] is True and p2["findings"] == []
+
+        # edit the source: the content hash moves, the verdict re-traces
+        (tmp_path / "mod.py").write_text(
+            TINY_ENTRY.replace("x * 2", "x * 3")
+        )
+        p3, d3 = _stats_delta(lambda: trace_payload(build_context(tmp_path)))
+        assert d3 == {"hits": 0, "misses": 1}
+        assert p3["cacheHit"] is False
+
+        # a corrupt entry must read as a miss, never a crash
+        for p in trace_cache.glob("trace-*.json"):
+            p.write_text("{not json")
+        _, d4 = _stats_delta(lambda: trace_payload(build_context(tmp_path)))
+        assert d4 == {"hits": 0, "misses": 1}
+
+        # a worker-schema bump orphans every cached verdict
+        monkeypatch.setattr(rules_trace, "WORKER_SCHEMA", WORKER_SCHEMA + 1)
+        _, d5 = _stats_delta(lambda: trace_payload(build_context(tmp_path)))
+        assert d5 == {"hits": 0, "misses": 1}
+
+    def test_key_covers_every_linted_source(self, tmp_path):
+        (tmp_path / "mod.py").write_text(TINY_ENTRY)
+        (tmp_path / "other.py").write_text("X = 1\n")
+        k1 = content_key(build_context(tmp_path))
+        (tmp_path / "other.py").write_text("X = 2\n")
+        k2 = content_key(build_context(tmp_path))
+        # conservative by design: an edit anywhere in the linted set
+        # invalidates (kernel imports are transitive)
+        assert k1 != k2
+
+    def test_cached_findings_replay_without_worker(self, tmp_path,
+                                                   trace_cache):
+        (tmp_path / "mod.py").write_text(CALLBACK_ENTRY.format(suffix=""))
+        f1 = [
+            (f.rule, f.path, f.line)
+            for f in run_rules(build_context(tmp_path),
+                               rules=tier_rules("trace"), check_unused=False)
+        ]
+        assert ("trace-host-callback", "mod.py", 15) in f1
+        _, delta = _stats_delta(lambda: [
+            (f.rule, f.path, f.line)
+            for f in run_rules(build_context(tmp_path),
+                               rules=tier_rules("trace"), check_unused=False)
+        ])
+        assert delta == {"hits": 1, "misses": 0}
+
+
+class TestSuppression:
+    def test_trace_finding_suppressed_at_declaration_line(self, tmp_path,
+                                                          trace_cache):
+        body = CALLBACK_ENTRY.format(
+            suffix="  # cclint: disable=trace-host-callback -- fixture waiver"
+        )
+        (tmp_path / "mod.py").write_text(body)
+        findings = run_rules(build_context(tmp_path))
+        hits = [f for f in findings if f.rule == "trace-host-callback"]
+        assert hits and all(f.suppressed for f in hits)
+        assert not [f for f in findings if f.rule == "lint-unused-suppression"]
+
+    def test_token_only_run_does_not_flag_trace_suppression(self, tmp_path,
+                                                            trace_cache):
+        body = CALLBACK_ENTRY.format(
+            suffix="  # cclint: disable=trace-host-callback -- fixture waiver"
+        )
+        (tmp_path / "mod.py").write_text(body)
+        _, delta = _stats_delta(lambda: run_rules(
+            build_context(tmp_path), rules=tier_rules("token")
+        ))
+        findings = run_rules(build_context(tmp_path),
+                             rules=tier_rules("token"))
+        # the token tier cannot judge a trace-rule suppression: no stale
+        # finding, and no worker was spawned to find out
+        assert not [f for f in findings if f.rule == "lint-unused-suppression"]
+        assert delta == {"hits": 0, "misses": 0}
+
+
+class TestWorkerChecks:
+    """The pure check functions, driven in-process on hand-built jaxprs."""
+
+    def test_callback_detected_through_nesting(self):
+        def inner(x):
+            jax.debug.callback(lambda v: None, x)
+            return x + 1
+
+        def outer(x):
+            return jax.jit(inner)(x) * 2
+
+        closed = jax.make_jaxpr(outer)(jnp.zeros((3,), jnp.float32))
+        rules = {f["rule"] for f in check_jaxpr("e", closed, "m.py", 1, 1 << 16)}
+        assert "trace-host-callback" in rules
+
+    def test_weak_and_f64_free_kernel_is_clean(self):
+        def kernel(x):
+            c = jax.lax.while_loop(
+                lambda c: c < jnp.int32(3),
+                lambda c: c + jnp.int32(1),
+                jnp.zeros((), jnp.int32),
+            )
+            return x + c
+
+        closed = jax.make_jaxpr(kernel)(jnp.zeros((3,), jnp.float32))
+        assert check_jaxpr("e", closed, "m.py", 1, 1 << 16) == []
+
+    def test_weak_carry_flagged_inside_scan(self):
+        def kernel(x):
+            def body(c, _):
+                return c + 1.0, ()
+
+            c, _ = jax.lax.scan(body, 0.0, None, length=4)
+            return x + c
+
+        closed = jax.make_jaxpr(kernel)(jnp.zeros((3,), jnp.float32))
+        hits = [f for f in check_jaxpr("e", closed, "m.py", 7, 1 << 16)
+                if f["rule"] == "trace-carry-stability"]
+        assert hits and hits[0]["line"] == 7
+
+    def test_const_bloat_threshold_is_exclusive(self):
+        baked = jnp.arange(256, dtype=jnp.float32)  # 1024 bytes
+
+        def kernel(x):
+            return x + baked.sum()
+
+        closed = jax.make_jaxpr(kernel)(jnp.zeros((3,), jnp.float32))
+        assert check_jaxpr("e", closed, "m.py", 1, 1024) == []
+        flagged = check_jaxpr("e", closed, "m.py", 1, 1023)
+        assert [f["rule"] for f in flagged] == ["trace-constant-bloat"]
+
+    def test_donation_matches_by_shape_and_dtype(self):
+        def kernel(x, y):
+            return x + 1.0, jnp.sum(y)
+
+        args = (jnp.zeros((4,), jnp.float32), jnp.zeros((4,), jnp.float32))
+        closed = jax.make_jaxpr(kernel)(*args)
+        # x aliases output 0; y's only candidate is taken by x's donation
+        assert check_donation("e", closed, args, (0,), "m.py", 1) == []
+        dead = check_donation("e", closed, args, (0, 1), "m.py", 1)
+        assert [f["rule"] for f in dead] == ["trace-donation-integrity"]
+
+    def test_donation_flattens_pytree_arguments(self):
+        def kernel(pair):
+            a, b = pair
+            return (a * 2, b * 2)
+
+        pair = (jnp.zeros((2,), jnp.float32), jnp.zeros((3,), jnp.int32))
+        closed = jax.make_jaxpr(kernel)(pair)
+        assert check_donation("e", closed, (pair,), (0,), "m.py", 1) == []
+
+    def test_out_of_range_donation_position_is_a_finding(self):
+        def kernel(x):
+            return x
+
+        args = (jnp.zeros((2,), jnp.float32),)
+        closed = jax.make_jaxpr(kernel)(*args)
+        bad = check_donation("e", closed, args, (3,), "m.py", 1)
+        assert [f["rule"] for f in bad] == ["trace-donation-integrity"]
+
+    def test_entry_line_anchors_to_name_declaration(self):
+        lines = [
+            "CCLINT_TRACE_ENTRYPOINTS = [",
+            '    dict(name="first", build=_a),',
+            '    dict(name="second", build=_b),',
+            "]",
+        ]
+        assert _entry_line(lines, "first") == 2
+        assert _entry_line(lines, "second") == 3
+        assert _entry_line(lines, "absent") == 1
+
+
+class TestPackageRegistry:
+    def test_registry_covers_the_kernel_stack(self):
+        ctx = build_context(ROOT)
+        mods = {m.rel for m in entry_modules(ctx)}
+        assert "cruise_control_tpu/lint/entrypoints.py" in mods
+
+    def test_registry_names_the_roadmap_surfaces(self):
+        from cruise_control_tpu.lint import entrypoints
+
+        names = {e["name"] for e in entrypoints.CCLINT_TRACE_ENTRYPOINTS}
+        assert {
+            "fused-stack-step", "chunked-goal-machine", "bulk-count-round",
+            "pair-drain-round", "swap-round", "sharded-compute-aggregates",
+            "sharded-compute-stats",
+        } <= names
+
+
+class TestSharedCli:
+    """`python -m cruise_control_tpu.lint` and `scripts/cclint.py` are the
+    SAME CLI: identical exit codes across --tier and --rule filters."""
+
+    def _spawn(self, launcher, args):
+        cmd = {
+            "module": [sys.executable, "-m", "cruise_control_tpu.lint"],
+            "script": [sys.executable, str(ROOT / "scripts" / "cclint.py")],
+        }[launcher] + args
+        return subprocess.run(cmd, cwd=ROOT, capture_output=True, text=True,
+                              timeout=120).returncode
+
+    @pytest.mark.parametrize("launcher", ["module", "script"])
+    def test_exit_codes_match_inprocess_cli(self, launcher, tmp_path):
+        (tmp_path / "bad.py").write_text(
+            "def f(g):\n    while True:\n        g()\n"
+        )
+        cases = [
+            ["--root", str(tmp_path), "--tier", "token"],  # findings -> 1
+            ["--root", str(tmp_path), "--tier", "trace"],  # no entries -> 0
+            ["--rule", "no-such-rule"],  # usage error -> 2
+        ]
+        for args in cases:
+            assert self._spawn(launcher, args) == cclint_main(args), args

@@ -1,21 +1,27 @@
-"""Static invariants, enforced by the cclint framework (tier-1, compile-free).
+"""Static invariants, enforced by the cclint framework (tier-1).
 
 History: this module began as two hand-rolled AST checks (bare `except:`
 and unbounded `while True`) over four directories. Those checks are now
 cclint rules (`conc-bare-except`, `conc-unbounded-loop`) with per-rule
 fixtures, and this module is the tier-1 gate that runs the FULL rule set —
-TPU hygiene, concurrency discipline, registry consistency (docs/LINTING.md)
-— over the whole package and requires zero unsuppressed findings. The two
-original test names are kept so their history stays legible; they now pin
-the generalized package-wide scope of the rules they grew into.
+TPU hygiene, concurrency discipline, registry consistency, and the
+jaxpr-level trace tier certifying the kernel entry points
+(docs/LINTING.md) — over the whole package and requires zero unsuppressed
+findings. The two original test names are kept so their history stays
+legible; they now pin the generalized package-wide scope of the rules they
+grew into.
 
-Budget: the full run is pure ast/text (no JAX, no compiles) and must stay
-under 10 seconds — cheap enough that every future subsystem inherits the
-guardrails for free.
+Budget: the token tier is pure ast/text (no JAX, no compiles); the trace
+tier abstractly evaluates the registered kernel entry points in a worker
+subprocess, memoized on disk by source content hash (.cclint_cache/ ships
+warm entries for the committed tree). The 10-second contract is asserted
+on the cache-warm combined run: the first run after a kernel edit pays the
+re-trace once, every run after that is as cheap as PR 6's token-only gate.
 """
 
 from __future__ import annotations
 
+import functools
 import pathlib
 import time
 
@@ -27,11 +33,16 @@ from cruise_control_tpu.lint import (
     run_rules,
     unsuppressed,
 )
+from cruise_control_tpu.lint.rules_trace import trace_payload
 
 ROOT = pathlib.Path(__file__).resolve().parents[1]
 
 
+@functools.lru_cache(maxsize=1)
 def _package_context():
+    # shared across this module's tests: parsing the 99-file package once
+    # (~1.5 s) instead of per test keeps the lint gate's share of tier-1
+    # flat as the rule set grows; rules treat the context as read-only
     return build_context(ROOT)
 
 
@@ -42,16 +53,38 @@ def _fail_message(findings):
 
 
 def test_cclint_full_package_clean():
-    """The headline gate: every rule, every package file, zero unsuppressed
-    findings, and the whole thing inside the 10 s tier-1 budget."""
+    """The headline gate: every rule in BOTH tiers, every package file,
+    zero unsuppressed findings — the trace tier certifies the real fused
+    stack / goal machine / engine kernels / sharded dispatches along the
+    way — and the cache-warm combined run inside the 10 s budget. This is
+    the satellite budget assertion too: the timed section deliberately
+    REBUILDS the context (a fresh `scripts/cclint.py` invocation's work),
+    so the contract covers parse + both tiers, cache-warm."""
+    trace_payload(_package_context())  # prime (re-traces only after an edit)
     t0 = time.monotonic()
-    ctx = _package_context()
+    ctx = build_context(ROOT)
     findings = run_rules(ctx)
     elapsed = time.monotonic() - t0
     open_findings = unsuppressed(findings)
     assert not open_findings, _fail_message(open_findings)
     assert len(all_rules()) >= 10
+    payload = ctx.cache["trace-payload"]
+    assert payload["skipped"] is False, "package entry registry not found"
+    assert payload["cacheHit"] is True
     assert elapsed < 10.0, f"full-package lint took {elapsed:.1f}s (budget 10s)"
+
+
+def test_trace_tier_certifies_the_roadmap_entry_points():
+    """The ROADMAP-1/2 gate inherited by the round-fusion and sharding PRs:
+    the real entry points pass the trace rules as-is — no waivers — so the
+    fusibility/donation/sharding contracts are green before that work
+    starts, not established by it."""
+    ctx = _package_context()
+    payload = trace_payload(ctx)
+    stats = payload.get("stats", {})
+    assert stats.get("entryPoints", 0) >= 7, stats
+    trace_findings = [f for f in payload["findings"]]
+    assert not trace_findings, trace_findings
 
 
 def test_every_suppression_carries_a_reason_and_is_live():

@@ -293,13 +293,19 @@ def test_telemetry_and_history_overhead_under_2pct_of_proposal_wall():
     t = DeviceTelemetry()
     store = TimeSeriesStore(ring_size=64, boundary_min_spacing_s=0.0)
     n = 20
-    t0 = time.monotonic()
-    for _ in range(n):
-        t.record_transfer("h2d", 1 << 20)
-        t.record_transfer("d2h", 1 << 16)
-        t.update_memory()
-        store.record_boundary("proposal")
-    per_proposal = (time.monotonic() - t0) / n
+    # min over repeats: the contract bounds the HOOKS' cost, not scheduler
+    # noise on a loaded single-core CI box (the test_provenance min-of-7
+    # posture; a single 20-iteration pass flaked mid-suite at 765us vs the
+    # 660us budget while passing in isolation at a fraction of it)
+    per_proposal = float("inf")
+    for _ in range(5):
+        t0 = time.monotonic()
+        for _ in range(n):
+            t.record_transfer("h2d", 1 << 20)
+            t.record_transfer("d2h", 1 << 16)
+            t.update_memory()
+            store.record_boundary("proposal")
+        per_proposal = min(per_proposal, (time.monotonic() - t0) / n)
     budget = 0.02 * fastest_wall
     assert per_proposal < budget, (
         f"telemetry+history hooks cost {per_proposal * 1e6:.0f}us/proposal, "

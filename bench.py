@@ -7,6 +7,14 @@ All five BASELINE configs (BASELINE.md), largest last:
   4  add-broker + remove-broker drain   100 brokers /  10k partitions
   5  LinkedIn-scale snapshot          2,600 brokers / 200k partitions
 
+Config 6 (slow lane only — BENCH_CONFIG=6, never in the default stage list)
+is the north-star MESH run: the config-5 model sharded over every visible
+device (requires >= 2; the virtual-8 CPU mesh via
+XLA_FLAGS=--xla_force_host_platform_device_count=8 counts). Its record is
+config 5's shape plus "meshDevices", and its decision contract is that its
+provenanceDigest EQUALS a mesh-1 config-5 run's at the same seed — the
+sharded round loop may not change a single move (docs/SHARDING.md).
+
 North star (BASELINE.md): config 5 through the complete default hard+soft
 goal stack in < 10 s wall-clock on a v5e-8 with goal-violation scores <= the
 stock greedy. The greedy reference is produced here too: configs 1-4 run the
@@ -70,8 +78,16 @@ transfer totals) and telemetryOverheadPct (<2% contract, like tracing).
 scripts/perf_gate.py diffs a fresh BENCH_DETAIL.json against the committed
 baseline with per-metric tolerances and stable exit codes.
 
+Each detail record also carries a "collectives" block — cross-device
+collective op counts and bytes parsed from every compiled program's lowered
+HLO (common/telemetry.collective_stats), cumulative at the moment the config
+completed, with per-bucket rows and the per-round (while-body) sub-account.
+scripts/perf_gate.py diffs it like wall time: per-round collective growth on
+an unchanged config is a sharding regression even when the wall clock hides
+it behind compile-cache noise.
+
 Usage: python bench.py [--smoke]        # --smoke = config 1 only, fast
-Env overrides: BENCH_CONFIG (single config 1-5), BENCH_SEED,
+Env overrides: BENCH_CONFIG (single config 1-6), BENCH_SEED,
 BENCH_PROBE_TIMEOUT_S, BENCH_PROBE_RETRIES (default 3), BENCH_REPROBE=0 to
 disable mid-run re-probing, BENCH_STAGES (comma list, default "1,2,3,4,5"),
 BENCH_PARITY=0 to skip the greedy passes, BENCH_PARITY5_BROKERS (parity
@@ -387,6 +403,29 @@ def _observability_block(result, wall: float) -> dict:
     }
 
 
+def _collectives_block() -> dict:
+    """Cross-device collective account at the moment this config completed.
+
+    Totals are cumulative across the process (configs run smallest-first, so
+    run-over-run diffs always compare equal prefixes); the per-bucket rows
+    attribute growth to the program that pays it, and `perRound*` counts only
+    instructions inside `lax.while_loop` bodies — the traffic multiplied by
+    every round, which is what the <docs/SHARDING.md> budget bounds."""
+    from cruise_control_tpu.common.telemetry import TELEMETRY
+
+    totals = TELEMETRY.collective_totals()
+    by_bucket: dict = {}
+    for r in TELEMETRY.programs():
+        b = by_bucket.setdefault(
+            r.get("bucket", "?"), {"ops": 0, "bytes": 0, "perRoundOps": 0}
+        )
+        b["ops"] += r.get("collectiveOps", 0)
+        b["bytes"] += r.get("collectiveBytes", 0)
+        b["perRoundOps"] += (r.get("collectivesPerRound") or {}).get("ops", 0)
+    totals["byBucket"] = by_bucket
+    return totals
+
+
 def _default_options():
     from cruise_control_tpu.analyzer.context import OptimizationOptions
 
@@ -506,9 +545,16 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
     from cruise_control_tpu.common.resources import BrokerState
     from cruise_control_tpu.models.generators import BASELINE_CONFIGS, random_cluster
 
+    if cfg_id == 6 and (mesh is None or mesh.size < 2):
+        # the whole point of config 6 is the sharded round loop; a 1-device
+        # "mesh" run would just be config 5 with padding
+        raise RuntimeError(
+            "config 6 is the north-star MESH run: need >1 visible device "
+            "(e.g. XLA_FLAGS=--xla_force_host_platform_device_count=8)"
+        )
     compile0 = _compile_counters()
     t_build = time.monotonic()
-    model = random_cluster(seed, BASELINE_CONFIGS[cfg_id])
+    model = random_cluster(seed, BASELINE_CONFIGS[5 if cfg_id == 6 else cfg_id])
     log(
         f"[config {cfg_id}] model: {model.num_brokers} brokers / "
         f"{model.num_partitions} partitions / rf {model.assignment.shape[1]} "
@@ -566,8 +612,10 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
             "goals": _goal_table(add_result),
             "observability": obs,
             "bucketed": _bucketed_block(add_result, compile0),
+            "collectives": _collectives_block(),
             **({"provenance": prov_block} if prov_block else {}),
         }
+        payload["collectiveOpsPerRound"] = detail["collectives"]["perRoundOps"]
         payload["programsCompiled"] = _compile_counters()["programs"]
         payload["compileSTotal"] = _compile_counters()["compileS"]
         if parity:
@@ -602,11 +650,12 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
             "CpuUsageDistributionGoal",
         ]
     wall, result = _timed(optimizer, model, cfg_id, "batched", goal_names=goal_names)
+    mesh_label = f"mesh-{mesh.size}, " if cfg_id == 6 else ""
     payload = {
         "metric": (
             f"full-goal proposal generation, BASELINE config {cfg_id} "
             f"({model.num_brokers} brokers / {model.num_partitions} partitions, "
-            f"{platform})"
+            f"{mesh_label}{platform})"
         ),
         "value": round(wall, 3),
         "unit": "s",
@@ -628,13 +677,20 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         "violatedAfter": result.violated_goals_after,
         "observability": obs,
         "bucketed": _bucketed_block(result, compile0),
+        "collectives": _collectives_block(),
         **({"provenance": prov_block} if prov_block else {}),
     }
+    payload["collectiveOpsPerRound"] = detail["collectives"]["perRoundOps"]
     payload["programsCompiled"] = _compile_counters()["programs"]
     payload["compileSTotal"] = _compile_counters()["compileS"]
-    if cfg_id == 5:
+    if cfg_id in (5, 6):
         payload["vs_baseline"] = round(TARGET_S / wall, 3)
-        if parity:
+        if cfg_id == 6:
+            # the parity contract for the mesh run is DECISION IDENTITY, not
+            # a greedy race: its provenanceDigest must equal a mesh-1
+            # config-5 run's at the same seed (scripts/perf_gate.py exit 5)
+            payload["meshDevices"] = mesh.size
+        if parity and cfg_id == 5:
             # the parity gate runs on the downscaled config-5-family model;
             # a failure zeroes vs_baseline (the contract is time AND scores)
             block = _parity5(seed, mesh, settings)

@@ -327,7 +327,8 @@ def _dst_candidates(static: StaticCtx, gs, agg: Aggregates, goal: Goal, dims: Di
 # kernels)
 
 
-def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
+def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings,
+                    mesh=None):
     """Build the per-goal optimization loop (rounds until no progress).
 
     Returns goal_loop(static, agg, tables, budget=None) ->
@@ -335,11 +336,23 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
     one segment of the fused whole-stack program (_make_stack_step) or as one
     switch branch of the chunked goal machine (_make_goal_machine); `tables`
     are the merged acceptance bounds of the goals already optimized before
-    this one."""
+    this one.
+
+    With a multi-device `mesh`, the [P, R, K] scoring grid + shortlist runs
+    as an explicit shard_map SPMD kernel (parallel.spmd.make_grid_shortlist):
+    each device scores its partition shard, one all-gather of per-shard
+    winners crosses the mesh per round, and the deterministic merge makes
+    the shortlist — and therefore every downstream decision — bit-identical
+    to the unsharded program."""
     p_count, r = dims.num_partitions, dims.max_rf
     k_dst = max(1, min(settings.num_dst_candidates, dims.num_racks))
     k_sel = max(1, min(settings.batch_k, p_count))
     use_leadership = goal.uses_leadership and r >= 2
+    spmd_shortlist = None
+    if mesh is not None and mesh.size > 1:
+        from cruise_control_tpu.parallel.spmd import make_grid_shortlist
+
+        spmd_shortlist = make_grid_shortlist(mesh, goal, dims, settings)
 
     def one_round(static: StaticCtx, agg: Aggregates, tables, rnd=jnp.int32(0)):
         gs = goal.prepare(static, agg, dims)
@@ -347,43 +360,53 @@ def _make_goal_loop(goal: Goal, dims: Dims, settings: OptimizerSettings):
         # ---- move family: [P, R, K] grid
         dst_cands = _dst_candidates(static, gs, agg, goal, dims, k_dst, tables)
         kk = dst_cands.shape[0]
-        best_score = jnp.full((p_count,), -jnp.inf)
-        best_kind = jnp.zeros((p_count,), dtype=jnp.int32)
-        best_slot = jnp.zeros((p_count,), dtype=jnp.int32)
-        best_dst = jnp.zeros((p_count,), dtype=jnp.int32)
 
-        if goal.uses_moves:
-            mv = make_move_batch(static.part_load, agg.assignment, dst_cands)
-            s = score_batch(static, agg, mv, goal, gs, tables)
-            s = jnp.broadcast_to(s, (p_count, r, kk)).reshape(p_count, r * kk)
-            j = jnp.argmax(s, axis=1)
-            sm = jnp.take_along_axis(s, j[:, None], axis=1)[:, 0]
-            best_score = sm
-            best_kind = jnp.full((p_count,), KIND_MOVE, dtype=jnp.int32)
-            best_slot = (j // kk).astype(jnp.int32)
-            best_dst = dst_cands[(j % kk).astype(jnp.int32)]
+        if spmd_shortlist is not None:
+            # SPMD grid: per-shard scoring + local top-k, one all-gather,
+            # deterministic merge — bit-identical to the unsharded shortlist
+            top_scores, sel_p, sel_kind, sel_slot, sel_dst0 = spmd_shortlist(
+                static, agg, gs, tables, dst_cands
+            )
+        else:
+            best_score = jnp.full((p_count,), -jnp.inf)
+            best_kind = jnp.zeros((p_count,), dtype=jnp.int32)
+            best_slot = jnp.zeros((p_count,), dtype=jnp.int32)
+            best_dst = jnp.zeros((p_count,), dtype=jnp.int32)
 
-        # ---- leadership family: [P, R-1] grid
-        if use_leadership:
-            lb = make_leadership_batch(static.part_load, agg.assignment)
-            sl = score_batch(static, agg, lb, goal, gs, tables)
-            sl = jnp.broadcast_to(sl, (p_count, r - 1))
-            j2 = jnp.argmax(sl, axis=1)
-            sbest = jnp.take_along_axis(sl, j2[:, None], axis=1)[:, 0]
-            lead_slot = (j2 + 1).astype(jnp.int32)
-            take_lead = sbest > best_score
-            best_score = jnp.maximum(best_score, sbest)
-            best_kind = jnp.where(take_lead, KIND_LEADERSHIP, best_kind)
-            best_slot = jnp.where(take_lead, lead_slot, best_slot)
-            rows = jnp.arange(p_count, dtype=jnp.int32)
-            best_dst = jnp.where(take_lead, agg.assignment[rows, lead_slot], best_dst)
+            if goal.uses_moves:
+                mv = make_move_batch(static.part_load, agg.assignment, dst_cands)
+                s = score_batch(static, agg, mv, goal, gs, tables)
+                s = jnp.broadcast_to(s, (p_count, r, kk)).reshape(p_count, r * kk)
+                j = jnp.argmax(s, axis=1)
+                sm = jnp.take_along_axis(s, j[:, None], axis=1)[:, 0]
+                best_score = sm
+                best_kind = jnp.full((p_count,), KIND_MOVE, dtype=jnp.int32)
+                best_slot = (j // kk).astype(jnp.int32)
+                best_dst = dst_cands[(j % kk).astype(jnp.int32)]
 
-        # ---- global top-k shortlist over partitions
-        top_scores, top_p = jax.lax.top_k(best_score, k_sel)
-        sel_p = top_p.astype(jnp.int32)
-        sel_kind = best_kind[top_p]
-        sel_slot = best_slot[top_p]
-        sel_dst0 = best_dst[top_p]
+            # ---- leadership family: [P, R-1] grid
+            if use_leadership:
+                lb = make_leadership_batch(static.part_load, agg.assignment)
+                sl = score_batch(static, agg, lb, goal, gs, tables)
+                sl = jnp.broadcast_to(sl, (p_count, r - 1))
+                j2 = jnp.argmax(sl, axis=1)
+                sbest = jnp.take_along_axis(sl, j2[:, None], axis=1)[:, 0]
+                lead_slot = (j2 + 1).astype(jnp.int32)
+                take_lead = sbest > best_score
+                best_score = jnp.maximum(best_score, sbest)
+                best_kind = jnp.where(take_lead, KIND_LEADERSHIP, best_kind)
+                best_slot = jnp.where(take_lead, lead_slot, best_slot)
+                rows = jnp.arange(p_count, dtype=jnp.int32)
+                best_dst = jnp.where(
+                    take_lead, agg.assignment[rows, lead_slot], best_dst
+                )
+
+            # ---- global top-k shortlist over partitions
+            top_scores, top_p = jax.lax.top_k(best_score, k_sel)
+            sel_p = top_p.astype(jnp.int32)
+            sel_kind = best_kind[top_p]
+            sel_slot = best_slot[top_p]
+            sel_dst0 = best_dst[top_p]
         # NOT capped at k_sel: with rank-paired destinations, later waves are
         # how a still-unapplied entry (greedy mode: THE entry) retries its
         # next-preferred destination after a failed validation
@@ -777,7 +800,8 @@ class StackMetrics(NamedTuple):
     state_fp: jax.Array  # u32[G]
 
 
-def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims,
+                     settings: OptimizerSettings, mesh=None):
     """Fuse the whole priority-ordered goal stack into one jitted program.
 
     The goal sequence is static, so the priority loop unrolls at trace time:
@@ -786,11 +810,15 @@ def _make_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: Optimize
     constraints once (bounds are invariant under moves within a run: total
     load/count and capacities don't change), which is exactly what the old
     per-goal build_tables recomputed from scratch each step.
+
+    `mesh`: a multi-device mesh routes every goal's grid round through the
+    shard_map SPMD kernel (see _make_goal_loop); the round loops still run
+    entirely on device inside this one program.
     """
     from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
 
     goals = [GOAL_REGISTRY[n] for n in goal_names]
-    loops = [_make_goal_loop(g, dims, settings) for g in goals]
+    loops = [_make_goal_loop(g, dims, settings, mesh) for g in goals]
 
     def stack_step(static: StaticCtx, agg: Aggregates):
         tables = empty_tables(dims)
@@ -874,12 +902,14 @@ _PROGRAM_CACHE_SIZE = 8
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
-    """One fused program per (goal stack, dims, settings)."""
-    return _make_stack_step(goal_names, dims, settings)
+def _cached_stack_step(goal_names: Tuple[str, ...], dims: Dims,
+                       settings: OptimizerSettings, mesh=None):
+    """One fused program per (goal stack, dims, settings, mesh)."""
+    return _make_stack_step(goal_names, dims, settings, mesh)
 
 
-def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
+def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims,
+                       settings: OptimizerSettings, mesh=None):
     """Bounded-duration executor: ONE jitted program that advances the
     priority stack by up to `budget` rounds per device call, CROSSING goal
     boundaries inside the call.
@@ -927,7 +957,7 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
     from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
 
     goals = [GOAL_REGISTRY[n] for n in goal_names]
-    loops = [_make_goal_loop(g, dims, settings) for g in goals]
+    loops = [_make_goal_loop(g, dims, settings, mesh) for g in goals]
     n_goals = len(goals)
     cap = settings.max_rounds_per_goal
 
@@ -1119,6 +1149,18 @@ def _make_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: Optimi
             (agg, tables, goal_idx, rounds_in_goal, empties_in_goal, metrics,
              budget, snap),
         )
+        if mesh is not None:
+            # the chunked driver feeds these outputs back as the next call's
+            # inputs, which it commits replicated; without a constraint GSPMD
+            # is free to emit them partition-sharded at large buckets (the
+            # snapshot rows are written from the sharded assignment), and the
+            # second dispatch then rejects the round-tripped buffers. Pinning
+            # output = input sharding also keeps the donation alias live.
+            rep = jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+            tables2, metrics2, snap2 = jax.tree_util.tree_map(
+                lambda x: jax.lax.with_sharding_constraint(x, rep),
+                (tables2, metrics2, snap2),
+            )
         return agg2, tables2, gi2, rig2, emp2, metrics2, budget - left2, snap2
 
     # donate the buffers the chunked driver threads through repeated calls
@@ -1195,8 +1237,9 @@ def _state_fingerprint(agg: Aggregates) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
-def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims, settings: OptimizerSettings):
-    return _make_goal_machine(goal_names, dims, settings)
+def _cached_goal_machine(goal_names: Tuple[str, ...], dims: Dims,
+                         settings: OptimizerSettings, mesh=None):
+    return _make_goal_machine(goal_names, dims, settings, mesh)
 
 
 @functools.lru_cache(maxsize=_PROGRAM_CACHE_SIZE)
@@ -1318,7 +1361,9 @@ def _stack_executable(goal_names, dims, settings, mesh, static, agg):
     )
     return _compile_cached(
         key, tag, dims,
-        lambda: _cached_stack_step(goal_names, dims, settings).lower(static, agg),
+        lambda: _cached_stack_step(goal_names, dims, settings, mesh).lower(
+            static, agg
+        ),
     )
 
 
@@ -1330,15 +1375,28 @@ def _machine_executable(goal_names, dims, settings, mesh, static, agg, tables):
         + (", mesh)" if mesh is not None else ")")
     )
     n_phases = 2 * len(goal_names) if settings.polish_rounds > 0 else len(goal_names)
-    return _compile_cached(
-        key, tag, dims,
-        lambda: _cached_goal_machine(goal_names, dims, settings).lower(
+
+    def lower():
+        metrics = empty_stack_metrics(len(goal_names))
+        enabled = jnp.ones((len(goal_names),), dtype=bool)
+        snap = empty_prov_snapshots(n_phases, dims, settings.ledger)
+        if mesh is not None:
+            # commit the sample carries to the SAME placement _run_chunked
+            # uses: an uncommitted sample leaves their in_shardings to GSPMD,
+            # which at large buckets shards the snapshot stack on the
+            # partition axis and then rejects the replicated buffers the
+            # driver actually passes
+            from cruise_control_tpu.parallel.sharding import place_replicated
+
+            metrics, enabled, snap = place_replicated(
+                (metrics, enabled, snap), mesh
+            )
+        return _cached_goal_machine(goal_names, dims, settings, mesh).lower(
             static, agg, tables, jnp.int32(0), jnp.int32(0), jnp.int32(0),
-            empty_stack_metrics(len(goal_names)), jnp.int32(1),
-            jnp.ones((len(goal_names),), dtype=bool),
-            empty_prov_snapshots(n_phases, dims, settings.ledger),
-        ),
-    )
+            metrics, jnp.int32(1), enabled, snap,
+        )
+
+    return _compile_cached(key, tag, dims, lower)
 
 
 def _machine_goal_plan(requested: Tuple[str, ...]):
@@ -1614,13 +1672,15 @@ class GoalOptimizer:
         if hit is not None:
             self._prep_cache.move_to_end(key)
             REGISTRY.meter("GoalOptimizer.static-ctx-cache-hits").mark()
-            p_orig, pmodel, dims, static, bucketed = hit[:5]
+            p_orig, pmodel, dims, static, static_canon, bucketed = hit[:6]
         else:
             REGISTRY.meter("GoalOptimizer.static-ctx-cache-misses").mark()
-            p_orig, pmodel, dims, static, bucketed = self._build_ctx(model, options)
+            (p_orig, pmodel, dims, static, static_canon,
+             bucketed) = self._build_ctx(model, options)
             # the entry references `model`/`options` to pin the key's ids
             self._prep_cache[key] = (
-                p_orig, pmodel, dims, static, bucketed, model, options,
+                p_orig, pmodel, dims, static, static_canon, bucketed,
+                model, options,
             )
             while len(self._prep_cache) > 2:
                 self._prep_cache.popitem(last=False)
@@ -1629,10 +1689,20 @@ class GoalOptimizer:
             TELEMETRY.record_transfer("h2d", tree_nbytes((pmodel, static)))
         # the aggregates input re-uploads each call (its output is donated)
         TELEMETRY.record_transfer("h2d", tree_nbytes(pmodel.assignment))
-        agg = _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
-        if self._mesh is not None:
+        if self._mesh is None:
+            agg = _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
+        else:
+            # canonical initial aggregates: run the segment_sums on the
+            # UNSHARDED static + a single-device assignment so the reduce
+            # order is bit-identical to a mesh-None run, then place the
+            # result onto the mesh (pure layout, no arithmetic). See the
+            # _build_ctx note — this is half of the decision-identity
+            # contract (docs/SHARDING.md).
             from cruise_control_tpu.parallel.sharding import place_aggregates
 
+            agg = _jit_compute_aggregates(
+                static_canon, jnp.asarray(np.asarray(pmodel.assignment)), dims
+            )
             agg = place_aggregates(agg, self._mesh)
         return goals, p_orig, pmodel, dims, static, agg, bucketed
 
@@ -1743,16 +1813,23 @@ class GoalOptimizer:
             num_hosts=num_hosts,
             num_topics=num_topics,
         )
-        if self._mesh is not None:
-            from cruise_control_tpu.parallel.sharding import place_static, shard_model
-
-            model = shard_model(model, self._mesh)
+        # build the StaticCtx UNSHARDED first: the canonical copy is what the
+        # initial-aggregates kernel reduces over each proposal computation.
+        # Computing those segment_sums on mesh-sharded inputs lets GSPMD
+        # split them into per-shard partials + a cross-shard reduce, whose
+        # float reassociation shifts broker loads by an ulp — enough to break
+        # the mesh-N == mesh-1 provenance-digest contract through the
+        # costDelta block even when every decision is identical.
         static = build_static_ctx(
             model, self._constraint, dims, options,
             valid_brokers=b_orig, valid_partitions=p_orig,
         )
+        static_canon = static
         if self._mesh is not None:
-            static = place_static(static, self._mesh)
+            from cruise_control_tpu.parallel.sharding import place_static, shard_model
+
+            model = shard_model(model, self._mesh)
+            static = place_static(static_canon, self._mesh)
         # exact vs padded shape record (the bench's `bucketed` detail block):
         # what the cluster measured vs what the compiled program is shaped for
         bucketed = {
@@ -1762,7 +1839,7 @@ class GoalOptimizer:
             "paddedPartitions": dims.num_partitions - p_orig,
             "paddedBrokers": dims.num_brokers - b_orig,
         }
-        return p_orig, model, dims, static, bucketed
+        return p_orig, model, dims, static, static_canon, bucketed
 
     def warmup(
         self,

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import os
 import pathlib
+import re
 import subprocess
 import threading
 import time
@@ -45,6 +46,137 @@ _COST_KEYS = {
     "bytes accessed": "bytesAccessed",
     "transcendentals": "transcendentals",
 }
+
+# -- collective accounting (lowered-HLO parse) ---------------------------------
+
+#: the cross-device ops worth metering (async `-start` forms count once;
+#: their `-done` halves carry no new traffic)
+_COLLECTIVE_OPS = (
+    "all-gather",
+    "all-reduce",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1,
+    "bf16": 2, "f16": 2, "s16": 2, "u16": 2,
+    "f32": 4, "s32": 4, "u32": 4,
+    "f64": 8, "s64": 8, "u64": 8, "c64": 8,
+    "c128": 16,
+}
+
+#: one `dtype[d0,d1,...]` shape atom (tuple shapes contain several)
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+#: a collective instruction: `%name = <shape> <op>(operands...)`; the shape is
+#: either a single atom (with optional layout braces) or a tuple
+_COLL_INSTR_RE = re.compile(
+    r"=\s*(\([^)]*\)|\S+)\s+("
+    + "|".join(_COLLECTIVE_OPS)
+    + r")(-start)?\("
+)
+
+#: an HLO computation header: `%region_0.17 (params) -> result {` (the entry
+#: computation is prefixed `ENTRY`)
+_COMPUTATION_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
+
+#: `while` instruction body reference and, inside body computations, any
+#: computation reference (fusions, conditionals, nested calls) — the edges we
+#: chase to attribute per-round traffic to the `lax.while_loop` closure
+_BODY_RE = re.compile(r"\bbody=%?([\w.\-]+)")
+_CALL_REFS_RE = re.compile(
+    r"(?:\bbody=|\bcondition=|\bto_apply=|%)([\w.\-]+)"
+)
+
+
+def _shape_bytes(shape_text: str) -> int:
+    """Total bytes of an HLO result shape (tuples sum their leaves)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_text):
+        size = _DTYPE_BYTES.get(dtype)
+        if size is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * size
+    return total
+
+
+def collective_stats(hlo_text: str) -> Dict:
+    """Parse lowered HLO into a collective-traffic account.
+
+    Returns `{ops, bytes, byOp: {op: {count, bytes}}, perRound: {...}}` where
+    `perRound` restricts the same account to instructions living in (the call
+    closure of) `while`-loop body computations — the fused round loop — so a
+    program's one-off prologue gathers don't masquerade as per-round traffic.
+    Bytes are the collective's *output* shape: what actually landed on each
+    device's interconnect, summed over instructions (not multiplied by mesh
+    size — the account is per-device, matching cost_analysis conventions).
+    """
+    # split the module into computations so instructions attribute to one
+    comp: Optional[str] = None
+    per_comp: Dict[str, List[str]] = {}
+    for line in hlo_text.splitlines():
+        m = _COMPUTATION_RE.match(line)
+        if m:
+            comp = m.group(1)
+            per_comp[comp] = []
+            continue
+        if comp is not None:
+            per_comp[comp].append(line)
+
+    def account(lines) -> Dict:
+        by_op: Dict[str, Dict] = {}
+        for line in lines:
+            m = _COLL_INSTR_RE.search(line)
+            if not m:
+                continue
+            shape_text, op = m.group(1), m.group(2)
+            slot = by_op.setdefault(op, {"count": 0, "bytes": 0})
+            slot["count"] += 1
+            slot["bytes"] += _shape_bytes(shape_text)
+        return by_op
+
+    # while bodies + their transitive callees = the per-round computations
+    body_roots = set()
+    for lines in per_comp.values():
+        for line in lines:
+            if " while(" in line:
+                body_roots.update(_BODY_RE.findall(line))
+    round_comps = set()
+    frontier = [b for b in body_roots if b in per_comp]
+    while frontier:
+        name = frontier.pop()
+        if name in round_comps:
+            continue
+        round_comps.add(name)
+        for line in per_comp[name]:
+            for ref in _CALL_REFS_RE.findall(line):
+                if ref in per_comp and ref not in round_comps:
+                    frontier.append(ref)
+
+    total = account(l for lines in per_comp.values() for l in lines)
+    per_round = account(
+        l for name in round_comps for l in per_comp[name]
+    )
+
+    def flat(by_op: Dict) -> Dict:
+        return {
+            "ops": sum(s["count"] for s in by_op.values()),
+            "bytes": sum(s["bytes"] for s in by_op.values()),
+        }
+
+    t, r = flat(total), flat(per_round)
+    return {
+        "ops": t["ops"],
+        "bytes": t["bytes"],
+        "byOp": total,
+        "perRound": {"ops": r["ops"], "bytes": r["bytes"], "byOp": per_round},
+    }
 
 
 def tree_nbytes(tree) -> int:
@@ -189,6 +321,16 @@ class DeviceTelemetry:
                 v = cost.get(key)
                 if isinstance(v, (int, float)):
                     record[field] = float(v)
+        try:
+            hlo = compiled.as_text()
+        except Exception:  # text dump is advisory like cost analysis
+            hlo = None
+        if hlo:
+            stats = collective_stats(hlo)
+            record["collectiveOps"] = stats["ops"]
+            record["collectiveBytes"] = stats["bytes"]
+            record["collectives"] = stats["byOp"]
+            record["collectivesPerRound"] = stats["perRound"]
         with self._lock:
             self._programs[(bucket, tag)] = record
             register_gauge = (
@@ -210,16 +352,42 @@ class DeviceTelemetry:
         """Flat numeric summary of one bucket's programs (the /metrics gauge)."""
         with self._lock:
             records = [r for (b, _), r in self._programs.items() if b == bucket]
-        out = {"programs": len(records), "flops": 0.0, "bytesAccessed": 0.0}
+        out = {
+            "programs": len(records), "flops": 0.0, "bytesAccessed": 0.0,
+            "collectiveOps": 0, "collectiveBytes": 0,
+        }
         for r in records:
             out["flops"] += r.get("flops", 0.0)
             out["bytesAccessed"] += r.get("bytesAccessed", 0.0)
+            out["collectiveOps"] += r.get("collectiveOps", 0)
+            out["collectiveBytes"] += r.get("collectiveBytes", 0)
         return out
 
     def programs(self) -> List[Dict]:
         """All recorded program cost records (the /perf payload rows)."""
         with self._lock:
             return [dict(r) for r in self._programs.values()]
+
+    def collective_totals(self) -> Dict:
+        """Collective-traffic totals across all recorded programs (the bench
+        record's `collectives` block and the perf_gate diff input)."""
+        with self._lock:
+            records = list(self._programs.values())
+        out: Dict = {
+            "ops": 0, "bytes": 0,
+            "perRoundOps": 0, "perRoundBytes": 0, "byOp": {},
+        }
+        for r in records:
+            out["ops"] += r.get("collectiveOps", 0)
+            out["bytes"] += r.get("collectiveBytes", 0)
+            per_round = r.get("collectivesPerRound") or {}
+            out["perRoundOps"] += per_round.get("ops", 0)
+            out["perRoundBytes"] += per_round.get("bytes", 0)
+            for op, slot in (r.get("collectives") or {}).items():
+                agg = out["byOp"].setdefault(op, {"count": 0, "bytes": 0})
+                agg["count"] += slot["count"]
+                agg["bytes"] += slot["bytes"]
+        return out
 
     # -- host<->device transfer meters -----------------------------------------
 
@@ -304,6 +472,7 @@ class DeviceTelemetry:
             "programs": self.programs(),
             "memory": self.memory(),
             "transfers": self.transfer_totals(),
+            "collectives": self.collective_totals(),
             "overheadS": round(self.overhead_s, 6),
         }
 

@@ -344,9 +344,14 @@ def _config_def() -> ConfigDef:
     d.define("zookeeper.security.enabled", Type.BOOLEAN, False, None, Importance.LOW,
              "Reference-compat: secure ZK for the managed cluster.")
     # --- TPU execution
-    # cclint: disable=reg-config-key-reachable -- reserved knob: the mesh axis is a constant (parallel/sharding.PARTITION_AXIS) until the ROADMAP-2 shard_map integration threads config through mesh construction; the sharded entry points that integration must keep green are already certified per commit by trace-sharding-lowering (lint/entrypoints.py: sharded-compute-aggregates / sharded-compute-stats under the 8-device mesh)
-    d.define("tpu.mesh.axis.name", Type.STRING, "shard", None, Importance.LOW,
-             "Mesh axis name candidate/partition arrays are sharded over.")
+    d.define("tpu.mesh.axis.name", Type.STRING, "partitions", None, Importance.LOW,
+             "Mesh axis name candidate/partition arrays are sharded over "
+             "(parallel/sharding.make_mesh_from_config; the shard_map kernels "
+             "read it back off the mesh, docs/SHARDING.md).")
+    d.define("tpu.mesh.devices", Type.INT, 0, at_least(0), Importance.LOW,
+             "Devices in the partition-axis mesh: 0 = auto (all visible "
+             "devices, mesh only when more than one), 1 = sharding disabled, "
+             "N = exactly the first N visible devices (error when fewer).")
     # cclint: disable=reg-config-key-reachable -- reserved knob: donation is unconditional in the jit factories (optimizer.py donate_argnums); making it configurable changes program identity and waits for the ROADMAP-1 on-device round fusion, whose donation set is certified per commit by trace-donation-integrity and whose while/scan carries by trace-carry-stability (lint/entrypoints.py: fused-stack-step / chunked-goal-machine)
     d.define("tpu.donate.model.buffers", Type.BOOLEAN, True, None, Importance.LOW,
              "Donate model buffers between optimizer rounds to avoid copies.")

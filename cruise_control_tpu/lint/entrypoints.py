@@ -25,6 +25,11 @@ engine factories):
   sharded-compute-aggregates  the partition-axis model aggregation under
                             the parallel/sharding.py PartitionSpec rules
   sharded-compute-stats     model stats under the same mesh placement
+  spmd-grid-shortlist       the explicit shard_map grid-scoring round
+                            shortlist — one winner all-gather per round
+                            (parallel/spmd.py, batch_k=1 grid engine)
+  spmd-partition-stats      the integer-psum shard-coverage stats kernel
+                            (zero all-gathers allowed)
 
 Everything heavy is imported inside the builders: this module is imported
 by the trace worker subprocess only — the in-process linter merely scans
@@ -38,13 +43,27 @@ at 200k.
 
 from __future__ import annotations
 
-#: all-gather budget for the sharded aggregation entries: XLA materializes
-#: a handful of tiny index all-gathers (s32 broker/topic id vectors) when
-#: scattering the per-partition shards into broker bins — measured 6 per
-#: entry on jax 0.4.37. The budget leaves two ops of layout-assignment
-#: jitter while still firing long before anything gathers the [P, M] load
-#: matrix itself (the replication class the rule exists for).
+#: Per-entry all-gather budgets (the worker's `max_all_gathers` is per-entry;
+#: one constant per entry class keeps each budget's rationale next to its
+#: number instead of flattening them into a shared ceiling):
+#:
+#: * aggregation entries — XLA materializes a handful of tiny index
+#:   all-gathers (s32 broker/topic id vectors) when scattering the
+#:   per-partition shards into broker bins: measured 6 per entry on jax
+#:   0.4.37. The budget leaves two ops of layout-assignment jitter while
+#:   still firing long before anything gathers the [P, M] load matrix
+#:   itself (the replication class the rule exists for).
 AGGREGATION_ALL_GATHER_BUDGET = 8
+#: * the SPMD grid-shortlist round kernel — its design IS one explicit
+#:   tuple all-gather of the per-shard winner 5-tuples (parallel/spmd.py),
+#:   which XLA lowers to one instruction per tuple leaf plus operand
+#:   references the worker's line count also matches: measured 12 lines on
+#:   jax 0.4.37. The budget leaves headroom for layout jitter while firing
+#:   if anything ever gathers a grid-sized array (thousands of lines).
+SPMD_SHORTLIST_ALL_GATHER_BUDGET = 16
+#: * the psum partition-stats kernel — pure integer psum (all-reduce);
+#:   ANY all-gather is a regression.
+SPMD_STATS_ALL_GATHER_BUDGET = 0
 
 #: partition-axis mesh the sharded entries must survive (ROADMAP-2's v5e-8)
 MESH_SHAPE = (("partitions", 8),)
@@ -219,6 +238,55 @@ def _build_sharded_stats():
     )
 
 
+def _build_spmd_grid_shortlist():
+    import jax.numpy as jnp
+
+    from cruise_control_tpu.analyzer import optimizer as opt
+    from cruise_control_tpu.analyzer.acceptance import empty_tables
+    from cruise_control_tpu.analyzer.goals import GOAL_REGISTRY
+    from cruise_control_tpu.parallel import spmd
+    from cruise_control_tpu.parallel.sharding import make_mesh
+
+    _model, dims, _settings, static, agg = _tiny_problem()
+    # batch_k=1 is the greedy/parity grid-engine mode — the regime the
+    # shard_map shortlist serves (optimizer._make_goal_loop routes batch_k>1
+    # and swap goals to the drain engines)
+    settings = opt.OptimizerSettings(batch_k=1)
+    goal = GOAL_REGISTRY["DiskUsageDistributionGoal"]
+    gs = goal.prepare(static, agg, dims)
+    dst_cands = jnp.arange(min(dims.num_brokers, 16), dtype=jnp.int32)
+    fn = spmd.make_grid_shortlist(make_mesh(8), goal, dims, settings)
+    return dict(
+        fn=fn,
+        args=(static, agg, gs, empty_tables(dims), dst_cands),
+        shardings=(
+            _partition_specs_for(static, spmd.STATIC_SHARDED_FIELDS),
+            _partition_specs_for(agg, spmd.AGG_SHARDED_FIELDS),
+            None, None, None,
+        ),
+        mesh_shape=MESH_SHAPE,
+        max_all_gathers=SPMD_SHORTLIST_ALL_GATHER_BUDGET,
+    )
+
+
+def _build_spmd_partition_stats():
+    from cruise_control_tpu.parallel import spmd
+    from cruise_control_tpu.parallel.sharding import make_mesh
+
+    _model, _dims, _settings, static, agg = _tiny_problem()
+    fn = spmd.make_partition_stats(make_mesh(8))
+    return dict(
+        fn=fn,
+        args=(static, agg),
+        shardings=(
+            _partition_specs_for(static, spmd.STATIC_SHARDED_FIELDS),
+            _partition_specs_for(agg, spmd.AGG_SHARDED_FIELDS),
+        ),
+        mesh_shape=MESH_SHAPE,
+        max_all_gathers=SPMD_STATS_ALL_GATHER_BUDGET,
+    )
+
+
 CCLINT_TRACE_ENTRYPOINTS = [
     dict(name="fused-stack-step", build=_build_fused_stack),
     dict(name="chunked-goal-machine", build=_build_goal_machine),
@@ -227,4 +295,6 @@ CCLINT_TRACE_ENTRYPOINTS = [
     dict(name="swap-round", build=_build_swap_round),
     dict(name="sharded-compute-aggregates", build=_build_sharded_aggregates),
     dict(name="sharded-compute-stats", build=_build_sharded_stats),
+    dict(name="spmd-grid-shortlist", build=_build_spmd_grid_shortlist),
+    dict(name="spmd-partition-stats", build=_build_spmd_partition_stats),
 ]

@@ -296,7 +296,7 @@ class ShardingLoweringRule(TraceRule):
         "sharded entry points must lower and compile under the virtual "
         "8-device partition mesh without ops that force the sharded axis "
         "to replicate (psum is the intended collective, PAPER.md) — the "
-        "gate the tpu.mesh.axis.name reservation's ROADMAP-2 work must pass"
+        "per-commit gate on the shard_map round kernels (docs/SHARDING.md)"
     )
 
 

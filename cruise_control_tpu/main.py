@@ -97,9 +97,15 @@ def build_simulated_service(
         from cruise_control_tpu.config.cruise_config import CruiseControlConfig
 
         cfg = CruiseControlConfig(load_properties(config_path))
+        # tpu.mesh.* -> partition-axis mesh (None on a single device or when
+        # tpu.mesh.devices=1); the optimizer threads it into the shard_map
+        # round kernels (docs/SHARDING.md)
+        from cruise_control_tpu.parallel.sharding import make_mesh_from_config
+
         optimizer = GoalOptimizer(
             constraint=BalancingConstraint.from_config(cfg),
             settings=OptimizerSettings.from_config(cfg),
+            mesh=make_mesh_from_config(cfg),
         )
         # resilience keys (docs/RESILIENCE.md): executor deadlines/concurrency
         # and the self-healing breaker ladder. The simulator driver needs no

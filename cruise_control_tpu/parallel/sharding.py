@@ -34,20 +34,51 @@ from cruise_control_tpu.models.flat_model import FlatClusterModel
 PARTITION_AXIS = "partitions"
 
 
-def make_mesh(n_devices: Optional[int] = None, devices=None) -> Mesh:
-    """1-D mesh over `partitions`. Defaults to all visible devices."""
+def make_mesh(
+    n_devices: Optional[int] = None, devices=None,
+    axis_name: str = PARTITION_AXIS,
+) -> Mesh:
+    """1-D mesh over the partition axis. Defaults to all visible devices.
+
+    `axis_name` renames the mesh axis (`tpu.mesh.axis.name`); everything
+    downstream — placement specs here, the shard_map kernels in
+    `parallel.spmd` — reads the name back off the mesh (`mesh.axis_names[0]`)
+    rather than assuming the constant, so a renamed axis flows through
+    shardings, collectives, and traces consistently."""
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
         if len(devices) < n_devices:
             raise ValueError(f"need {n_devices} devices, have {len(devices)}")
         devices = devices[:n_devices]
-    return Mesh(np.asarray(devices), (PARTITION_AXIS,))
+    return Mesh(np.asarray(devices), (axis_name,))
+
+
+def make_mesh_from_config(cfg) -> Optional[Mesh]:
+    """Mesh from the `tpu.mesh.*` keys (`main --config` ->
+    `GoalOptimizer(mesh=...)`).
+
+    `tpu.mesh.devices`: 0 = auto — all visible devices, and only when more
+    than one is visible (a 1-device mesh adds padding without parallelism);
+    1 = sharding explicitly disabled; N>1 = exactly the first N visible
+    devices (raises when fewer exist — a silently smaller mesh would change
+    which programs the compile cache considers warm)."""
+    n = cfg.get_int("tpu.mesh.devices")
+    axis = cfg.get_string("tpu.mesh.axis.name") or PARTITION_AXIS
+    if n == 1:
+        return None
+    if n == 0:
+        if len(jax.devices()) < 2:
+            return None
+        return make_mesh(axis_name=axis)
+    return make_mesh(n, axis_name=axis)
 
 
 def _p_sharding(mesh: Mesh, ndim: int) -> NamedSharding:
     """Shard dim 0 over the partition axis, replicate the rest."""
-    return NamedSharding(mesh, PartitionSpec(PARTITION_AXIS, *([None] * (ndim - 1))))
+    return NamedSharding(
+        mesh, PartitionSpec(mesh.axis_names[0], *([None] * (ndim - 1)))
+    )
 
 
 def _replicated(mesh: Mesh) -> NamedSharding:

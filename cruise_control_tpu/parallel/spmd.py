@@ -1,0 +1,243 @@
+# cclint: kernel-module
+"""Explicit `shard_map` SPMD kernels over the `partitions` mesh axis.
+
+`parallel.sharding` places arrays (partition-axis fields sharded, broker/
+rack/topic aggregates replicated) and lets GSPMD infer the collectives.
+This module makes the per-round hot path *explicit* instead: the [P, R, K]
+candidate grid — the dominant compute of the exhaustive scoring round
+(analyzer.optimizer one_round) — runs as a `shard_map` program where each
+device scores only its own partition shard against the replicated broker
+state, and the mesh is crossed exactly once per round.
+
+Round anatomy (make_grid_shortlist):
+
+  1. **Local scoring** — each device builds the move/leadership grids over
+     its P/D partition rows (actions.make_move_batch is row-local: every
+     candidate reads only `act.p` rows of the sharded fields plus the
+     replicated broker aggregates) and reduces to a per-partition best
+     (score, kind, slot, dst). Zero communication.
+  2. **Local top-k** — `lax.top_k` over the shard's per-partition bests.
+     k_local = min(k_sel, P/D), so the union of per-shard winners always
+     contains the global top-k_sel.
+  3. **One all-gather** — the per-shard winner tuples (score, global index,
+     kind, slot, dst) cross the mesh once: 5 arrays of k_local elements per
+     device, tiny against ICI bandwidth.
+  4. **Deterministic merge** — every device sorts the gathered [D * k_local]
+     winners by (-score, global index) and keeps the first k_sel. This
+     reproduces `lax.top_k`'s value order AND its lowest-index tie-break
+     bit-for-bit, which is what makes a mesh-N run provenance-digest-equal
+     to mesh-1: the shortlist — the only cross-shard decision — is
+     identical by construction, and everything downstream (apply waves,
+     precision wave) computes from the replicated shortlist + replicated
+     broker aggregates. Shard-order-dependent reductions (psum of float
+     scores, gather-order argmax) are exactly what this merge avoids.
+
+The apply path stays outside the shard_map: winner application touches
+[k_sel] rows (gather + scatter into the sharded assignment/touch_tag with
+replicated indices) and the replicated broker aggregates, both of which
+GSPMD already lowers without extra mesh crossings.
+
+`make_partition_stats` is the integer-`psum` companion: exact per-shard
+counts reduced across the mesh (int sums are associative, so unlike float
+reductions they cannot perturb digests), used by the multichip dryrun to
+certify shard coverage and registered as a lint trace entry.
+
+All kernels use `check_rep=False`: the replicated outputs are produced from
+all-gathered (or psum'd) values by identical per-device computation, which
+shard_map's static replication checker cannot see through the sort/gather
+ops; the mesh-equivalence tests assert the stronger property (bit-identical
+decisions) end to end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec
+
+from cruise_control_tpu.analyzer.context import Aggregates, StaticCtx
+from cruise_control_tpu.parallel.sharding import PARTITION_AXIS
+
+#: StaticCtx fields carried with a leading partition axis (must mirror
+#: parallel.sharding.place_static — the shard_map in_specs and the GSPMD
+#: placement hints describe the SAME layout, so no resharding happens at the
+#: shard_map boundary).
+STATIC_SHARDED_FIELDS = frozenset({"part_load", "topic_id", "movable_partition"})
+
+#: Aggregates fields with a leading partition axis (mirror of
+#: parallel.sharding.place_aggregates).
+AGG_SHARDED_FIELDS = frozenset({"assignment", "rack_replica_count", "touch_tag"})
+
+
+def static_partition_specs(axis: str = PARTITION_AXIS) -> StaticCtx:
+    """PartitionSpec tree for a StaticCtx (shard_map in_specs / lint entries)."""
+    return StaticCtx(**{
+        f: PartitionSpec(axis) if f in STATIC_SHARDED_FIELDS else PartitionSpec()
+        for f in StaticCtx._fields
+    })
+
+
+def agg_partition_specs(axis: str = PARTITION_AXIS) -> Aggregates:
+    """PartitionSpec tree for Aggregates (shard_map in_specs / lint entries)."""
+    return Aggregates(**{
+        f: PartitionSpec(axis) if f in AGG_SHARDED_FIELDS else PartitionSpec()
+        for f in Aggregates._fields
+    })
+
+
+def replicated_specs(tree):
+    """A PartitionSpec() for every leaf of an arbitrary pytree (goal state,
+    acceptance tables: broker/topic-sized values every shard reads whole)."""
+    return jax.tree_util.tree_map(lambda _: PartitionSpec(), tree)
+
+
+def make_grid_shortlist(mesh: Mesh, goal, dims, settings):
+    """Build the SPMD grid-scoring round kernel for one goal.
+
+    Returns shortlist(static, agg, gs, tables, dst_cands) ->
+    (top_scores f32[k_sel], sel_p i32[k_sel], sel_kind i32[k_sel],
+    sel_slot i32[k_sel], sel_dst i32[k_sel]) — bit-identical to the
+    unsharded `lax.top_k` shortlist of analyzer.optimizer's one_round
+    (see module docstring for why), with the [P, R, K] scoring grid
+    partitioned across the mesh. Traceable inside jit / while_loop; the
+    caller guarantees dims.num_partitions is a multiple of mesh.size
+    (GoalOptimizer._build_ctx pads to it).
+    """
+    from cruise_control_tpu.analyzer.acceptance import score_batch
+    from cruise_control_tpu.analyzer.actions import (
+        KIND_LEADERSHIP,
+        KIND_MOVE,
+        make_leadership_batch,
+        make_move_batch,
+    )
+
+    p_count, r = dims.num_partitions, dims.max_rf
+    n_dev = mesh.size
+    axis = mesh.axis_names[0]  # tpu.mesh.axis.name flows through the mesh
+    if p_count % n_dev != 0:
+        raise ValueError(
+            f"partition axis {p_count} not divisible by mesh size {n_dev}"
+        )
+    p_local = p_count // n_dev
+    k_sel = max(1, min(settings.batch_k, p_count))
+    # min(k_sel, P/D) per shard: when the shard is smaller than the
+    # shortlist, it contributes ALL its rows, so the gathered union still
+    # contains the global top-k_sel
+    k_loc = min(k_sel, p_local)
+    use_leadership = goal.uses_leadership and r >= 2
+
+    def local_grid(static: StaticCtx, agg: Aggregates, gs, tables, dst_cands):
+        # identical math to the unsharded grid, over this shard's rows: the
+        # candidate builders and scoring kernels only read `act.p` rows of
+        # the sharded fields (actions.make_move_batch; acceptance.py), so
+        # local row indices against local shards produce bitwise-identical
+        # per-candidate scores
+        kk = dst_cands.shape[0]
+        best_score = jnp.full((p_local,), -jnp.inf)
+        best_kind = jnp.zeros((p_local,), dtype=jnp.int32)
+        best_slot = jnp.zeros((p_local,), dtype=jnp.int32)
+        best_dst = jnp.zeros((p_local,), dtype=jnp.int32)
+
+        if goal.uses_moves:
+            mv = make_move_batch(static.part_load, agg.assignment, dst_cands)
+            s = score_batch(static, agg, mv, goal, gs, tables)
+            s = jnp.broadcast_to(s, (p_local, r, kk)).reshape(p_local, r * kk)
+            j = jnp.argmax(s, axis=1)
+            best_score = jnp.take_along_axis(s, j[:, None], axis=1)[:, 0]
+            best_kind = jnp.full((p_local,), KIND_MOVE, dtype=jnp.int32)
+            best_slot = (j // kk).astype(jnp.int32)
+            best_dst = dst_cands[(j % kk).astype(jnp.int32)]
+
+        if use_leadership:
+            lb = make_leadership_batch(static.part_load, agg.assignment)
+            sl = score_batch(static, agg, lb, goal, gs, tables)
+            sl = jnp.broadcast_to(sl, (p_local, r - 1))
+            j2 = jnp.argmax(sl, axis=1)
+            sbest = jnp.take_along_axis(sl, j2[:, None], axis=1)[:, 0]
+            lead_slot = (j2 + 1).astype(jnp.int32)
+            take_lead = sbest > best_score
+            best_score = jnp.maximum(best_score, sbest)
+            best_kind = jnp.where(take_lead, KIND_LEADERSHIP, best_kind)
+            best_slot = jnp.where(take_lead, lead_slot, best_slot)
+            rows = jnp.arange(p_local, dtype=jnp.int32)
+            best_dst = jnp.where(
+                take_lead, agg.assignment[rows, lead_slot], best_dst
+            )
+
+        # per-shard winners -> global indices
+        loc_scores, loc_p = jax.lax.top_k(best_score, k_loc)
+        offset = jax.lax.axis_index(axis).astype(jnp.int32) * p_local
+        glob_p = loc_p.astype(jnp.int32) + offset
+
+        # the ONE mesh crossing of the round: [D, k_loc] winner tuples
+        g_score, g_p, g_kind, g_slot, g_dst = jax.lax.all_gather(
+            (loc_scores, glob_p, best_kind[loc_p], best_slot[loc_p],
+             best_dst[loc_p]),
+            axis,
+        )
+        g_score = g_score.reshape(-1)
+        g_p = g_p.reshape(-1)
+        g_kind = g_kind.reshape(-1)
+        g_slot = g_slot.reshape(-1)
+        g_dst = g_dst.reshape(-1)
+
+        # deterministic merge == global lax.top_k: descending score, ties to
+        # the LOWEST global partition index (XLA top_k's stable tie-break)
+        order = jnp.lexsort((g_p, -g_score))
+        sel = order[:k_sel]
+        return g_score[sel], g_p[sel], g_kind[sel], g_slot[sel], g_dst[sel]
+
+    static_spec = static_partition_specs(axis)
+    agg_spec = agg_partition_specs(axis)
+    rep = PartitionSpec()
+
+    def shortlist(static: StaticCtx, agg: Aggregates, gs, tables, dst_cands):
+        fn = shard_map(
+            local_grid, mesh,
+            in_specs=(static_spec, agg_spec, replicated_specs(gs),
+                      replicated_specs(tables), rep),
+            out_specs=(rep, rep, rep, rep, rep),
+            check_rep=False,
+        )
+        return fn(static, agg, gs, tables, dst_cands)
+
+    return shortlist
+
+
+def make_partition_stats(mesh: Mesh):
+    """Exact integer shard-coverage stats, reduced with explicit `psum`.
+
+    Returns stats(static, agg) -> (movable i32[], assigned_slots i32[],
+    rows i32[]): the mesh-wide count of movable partitions, populated
+    assignment slots, and partition rows, each computed per shard and
+    psum'd across `partitions`. Integer sums are associative, so the mesh
+    total is exactly the mesh-1 value — the dryrun's shard-coverage
+    certificate (every row is owned by exactly one shard) and the lint
+    trace tier's smallest sharded entry.
+    """
+
+    axis = mesh.axis_names[0]
+
+    def local_stats(static: StaticCtx, agg: Aggregates):
+        movable = jnp.sum(static.movable_partition.astype(jnp.int32))
+        assigned = jnp.sum((agg.assignment >= 0).astype(jnp.int32))
+        rows = jnp.full((), agg.assignment.shape[0], dtype=jnp.int32)
+        return (
+            jax.lax.psum(movable, axis),
+            jax.lax.psum(assigned, axis),
+            jax.lax.psum(rows, axis),
+        )
+
+    rep = PartitionSpec()
+
+    def stats(static: StaticCtx, agg: Aggregates):
+        fn = shard_map(
+            local_stats, mesh,
+            in_specs=(static_partition_specs(axis), agg_partition_specs(axis)),
+            out_specs=(rep, rep, rep),
+            check_rep=False,
+        )
+        return fn(static, agg)
+
+    return stats

@@ -14,6 +14,15 @@ Checks per config pair (each individually tolerable):
   programsCompiled candidate <= baseline + --tol-programs  (compile-
                    amortization regressions are absolute, not relative)
   parityOk         may not flip true -> false
+  collectiveOps    per-round collective op count (the `collectives` block
+                   bench.py emits from the lowered HLO) may not grow beyond
+                   --tol-collective-ops (absolute, default 0: an extra mesh
+                   crossing per round is a sharding regression even when the
+                   wall clock hides it); per-round collective BYTES get
+                   relative slack (--tol-collective-bytes) since shape-bucket
+                   padding legitimately moves them. Skipped when either
+                   record predates the block or the platforms differ (each
+                   backend lowers its own collectives).
 
 Provenance checks (the r05 class):
   * candidate records missing a fingerprint block fail (bench.py now always
@@ -151,6 +160,23 @@ class Gate:
                 cid, "moves", abs(cm - bm) <= slack,
                 f"moves {cm} vs baseline {bm} (slack +-{slack:.0f})",
             )
+        b_coll, c_coll = b.get("collectives"), c.get("collectives")
+        if walls and isinstance(b_coll, dict) and isinstance(c_coll, dict):
+            bo, co = b_coll.get("perRoundOps"), c_coll.get("perRoundOps")
+            if isinstance(bo, int) and isinstance(co, int):
+                self.check(
+                    cid, "collectiveOps", co <= bo + a.tol_collective_ops,
+                    f"per-round collective ops {co} vs baseline {bo} "
+                    f"(+{a.tol_collective_ops} allowed)",
+                )
+            bb, cb = b_coll.get("perRoundBytes"), c_coll.get("perRoundBytes")
+            if isinstance(bb, (int, float)) and isinstance(cb, (int, float)) and bb > 0:
+                limit_b = bb * (1.0 + a.tol_collective_bytes)
+                self.check(
+                    cid, "collectiveBytes", cb <= limit_b,
+                    f"per-round collective bytes {cb} vs baseline {bb} "
+                    f"(limit {limit_b:.0f}, tol {a.tol_collective_bytes:+.0%})",
+                )
         bp, cp = b.get("programsCompiled"), c.get("programsCompiled")
         if isinstance(bp, int) and isinstance(cp, int):
             self.check(
@@ -190,6 +216,12 @@ def main(argv=None) -> int:
                         help="relative replica-move-count slack (default +-25%%)")
     parser.add_argument("--tol-programs", type=int, default=0,
                         help="absolute extra compiled programs allowed (default 0)")
+    parser.add_argument("--tol-collective-ops", type=int, default=0,
+                        help="absolute extra per-round collective ops allowed "
+                             "(default 0: no new mesh crossings per round)")
+    parser.add_argument("--tol-collective-bytes", type=float, default=0.25,
+                        help="relative per-round collective-bytes slack "
+                             "(default +25%%; shape-bucket padding moves bytes)")
     parser.add_argument("--allow-platform-mismatch", action="store_true",
                         help="compare across platforms (wall/round checks skipped)")
     parser.add_argument("--allow-unfingerprinted", action="store_true",

@@ -314,7 +314,8 @@ class TestPackageRegistry:
         assert {
             "fused-stack-step", "chunked-goal-machine", "bulk-count-round",
             "pair-drain-round", "swap-round", "sharded-compute-aggregates",
-            "sharded-compute-stats",
+            "sharded-compute-stats", "spmd-grid-shortlist",
+            "spmd-partition-stats",
         } <= names
 
 

@@ -52,12 +52,18 @@ GOALS = [
 ]
 
 
+@pytest.mark.slow
 def test_mesh_equivalence_full_run(model, mesh):
     """Same model, mesh=None vs an 8-device mesh: identical final assignment.
 
     The program is deterministic (argmax/top_k tie-breaking is index-order in
     XLA on both layouts), so equality is exact — if this ever diverges on a
-    backend, compare violated sets + costs instead and fix tie-breaking."""
+    backend, compare violated sets + costs instead and fix tie-breaking.
+
+    Slow lane (with the padding case below): the two 5-goal mesh compiles
+    dwarf the subject, and tier-1 keeps the same contract in
+    tests/test_spmd.py as a provenance-digest identity check plus the
+    mesh-divisible padding-invariance case."""
     base = GoalOptimizer(settings=SETTINGS).optimizations(
         model, GOALS, raise_on_hard_failure=False
     )
@@ -73,9 +79,11 @@ def test_mesh_equivalence_full_run(model, mesh):
     sanity_check(model._replace(assignment=sharded.final_assignment))
 
 
+@pytest.mark.slow
 def test_mesh_padding_rows_are_inert(model, mesh):
     """A partition count that is not a multiple of the mesh size pads up; pad
-    rows must produce no proposals and survive the round-trip."""
+    rows must produce no proposals and survive the round-trip. Slow lane:
+    rides the mesh program compiled by the equivalence run above."""
     trimmed = model._replace(
         assignment=np.asarray(model.assignment)[:-3],
         part_load=np.asarray(model.part_load)[:-3],

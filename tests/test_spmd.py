@@ -1,0 +1,177 @@
+"""Explicit-SPMD (shard_map) grid-engine tests: decision identity is the
+contract.
+
+`batch_k=1` routes move-only goals through the grid engine, and with a
+mesh attached the engine's per-round shortlist runs inside
+`parallel.spmd.make_grid_shortlist` — a `shard_map` over the partition
+axis whose only cross-device traffic is ONE tuple all-gather of the
+per-shard top-k, merged deterministically by (score desc, global index
+asc) lexsort. These tests pin the docs/SHARDING.md contract: a mesh-8 run
+must be decision-identical to a mesh-1 run — same final assignment, same
+violated set, and the SAME provenance digest checksum (the canonical move
+list hashed move by move), not merely an equally-good balance.
+
+Swap-family goals (usage distribution) keep the GSPMD-hint drain engine
+even at batch_k=1; mixing them into the stacks below deliberately covers
+the hybrid boundary where a shard_map goal hands its aggregates to a
+hint-sharded one.
+
+Fast lane stays tiny (tier-1 runs near its wall budget): one 3-goal stack,
+one padding case, one psum certificate. The full goal-family matrix rides
+the slow lane (`--runslow`).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import optimizer as opt_mod
+from cruise_control_tpu.analyzer.context import build_static_ctx, dims_of
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.config.balancing import BalancingConstraint
+from cruise_control_tpu.models import generators
+from cruise_control_tpu.models.flat_model import sanity_check
+from cruise_control_tpu.parallel.sharding import make_mesh, pad_partitions
+from cruise_control_tpu.parallel.spmd import make_partition_stats
+
+#: batch_k=1 is the grid-engine (greedy/parity) mode — the shard_map path.
+#: Everything else stays small: these compile the full mesh program, which
+#: dominates the test's wall clock.
+GRID_SETTINGS = OptimizerSettings(
+    batch_k=1, max_rounds_per_goal=6, num_dst_candidates=8,
+)
+
+#: one shard_map move goal, one hybrid swap goal, one leadership goal
+GRID_GOALS = [
+    "RackAwareGoal",
+    "DiskUsageDistributionGoal",
+    "LeaderReplicaDistributionGoal",
+]
+
+
+@pytest.fixture(scope="module")
+def model():
+    prop = generators.ClusterProperty(
+        num_racks=4, num_brokers=12, num_topics=16,
+        mean_partitions_per_topic=7.0, replication_factor=2,
+        load_distribution="exponential", mean_utilization=0.4,
+    )
+    return generators.random_cluster(seed=11, prop=prop)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must pin 8 virtual CPU devices"
+    return make_mesh(8)
+
+
+def _digest(result):
+    assert result.provenance is not None, "ledger must be on (default)"
+    return result.provenance.digest()
+
+
+@pytest.fixture(scope="module")
+def base_result(model):
+    return GoalOptimizer(settings=GRID_SETTINGS).optimizations(
+        model, GRID_GOALS, raise_on_hard_failure=False
+    )
+
+
+@pytest.fixture(scope="module")
+def mesh_result(model, mesh):
+    return GoalOptimizer(settings=GRID_SETTINGS, mesh=mesh).optimizations(
+        model, GRID_GOALS, raise_on_hard_failure=False
+    )
+
+
+def test_grid_engine_decision_identity(model, base_result, mesh_result):
+    """mesh-8 vs mesh-1, batch_k=1: provenance-digest-equal, not just
+    equally balanced. The digest hashes the canonical move list, so equality
+    means every round picked the SAME move on both layouts."""
+    base, sharded = base_result, mesh_result
+    np.testing.assert_array_equal(
+        base.final_assignment, sharded.final_assignment
+    )
+    assert base.violated_goals_after == sharded.violated_goals_after
+    db, ds = _digest(base), _digest(sharded)
+    assert db["checksum"] == ds["checksum"]
+    assert db["moves"] == ds["moves"]
+    assert db["byGoal"] == ds["byGoal"]
+    # a degenerate run (zero moves) would make the identity vacuous
+    assert ds["moves"] > 0
+    sanity_check(model._replace(assignment=sharded.final_assignment))
+
+
+def test_padding_invariance_at_mesh_divisible_sizes(model, mesh, mesh_result):
+    """Pre-padding the model to a mesh-divisible partition count must not
+    change any decision: pad rows are unassigned/immovable, so the sharded
+    grid sees them as dead candidates on the owning shard."""
+    padded = pad_partitions(model, mesh.size)
+    assert padded.num_partitions % mesh.size == 0
+    raw = mesh_result
+    pre = GoalOptimizer(settings=GRID_SETTINGS, mesh=mesh).optimizations(
+        padded, GRID_GOALS, raise_on_hard_failure=False
+    )
+    p = model.num_partitions
+    np.testing.assert_array_equal(
+        np.asarray(pre.final_assignment)[:p], raw.final_assignment
+    )
+    assert _digest(pre)["checksum"] == _digest(raw)["checksum"]
+    # pad rows came back untouched: still fully unassigned
+    assert np.all(np.asarray(pre.final_assignment)[p:] < 0)
+
+
+def test_partition_stats_psum_matches_host(model, mesh):
+    """The shard-coverage certificate: integer psums across the mesh equal
+    the host's exact counts — every padded row is owned by exactly one
+    shard, none double-counted, none dropped."""
+    padded = pad_partitions(model, mesh.size)
+    dims = dims_of(padded)
+    static = build_static_ctx(padded, BalancingConstraint.default(), dims)
+    agg = opt_mod._jit_compute_aggregates(static, padded.assignment, dims)
+    movable, assigned, rows = (
+        int(x) for x in make_partition_stats(mesh)(static, agg)
+    )
+    assert rows == padded.num_partitions
+    assert assigned == int((np.asarray(padded.assignment) >= 0).sum())
+    assert movable == int(np.asarray(static.movable_partition).sum())
+
+
+#: the registry partitioned by engine/feature family — the slow-lane matrix
+#: runs one stack per family so a digest break localizes to a family
+GOAL_FAMILIES = {
+    "capacity": [
+        "ReplicaCapacityGoal", "DiskCapacityGoal",
+        "NetworkInboundCapacityGoal", "CpuCapacityGoal",
+    ],
+    "distribution": [
+        "ReplicaDistributionGoal", "TopicReplicaDistributionGoal",
+        "PotentialNwOutGoal",
+    ],
+    "leadership": [
+        "NetworkOutboundCapacityGoal", "LeaderReplicaDistributionGoal",
+        "LeaderBytesInDistributionGoal",
+    ],
+    "usage-swap": [
+        "DiskUsageDistributionGoal", "NetworkInboundUsageDistributionGoal",
+        "NetworkOutboundUsageDistributionGoal", "CpuUsageDistributionGoal",
+    ],
+}
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("family", sorted(GOAL_FAMILIES))
+def test_goal_family_decision_identity(model, mesh, family):
+    """--runslow matrix: every goal family, mesh-8 digest-equal to mesh-1."""
+    goals = GOAL_FAMILIES[family]
+    base = GoalOptimizer(settings=GRID_SETTINGS).optimizations(
+        model, goals, raise_on_hard_failure=False
+    )
+    sharded = GoalOptimizer(settings=GRID_SETTINGS, mesh=mesh).optimizations(
+        model, goals, raise_on_hard_failure=False
+    )
+    np.testing.assert_array_equal(
+        base.final_assignment, sharded.final_assignment
+    )
+    assert base.violated_goals_after == sharded.violated_goals_after
+    assert _digest(base)["checksum"] == _digest(sharded)["checksum"]

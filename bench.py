@@ -96,7 +96,20 @@ cost-scaled round-cap ceiling, default 4096), BENCH_POLISH_ROUNDS (batched
 full-table polish pass budget per goal, default 48; 0 disables),
 BENCH_LEDGER_DIR (write every timed pass's decision-provenance RunLedger —
 analyzer/provenance.py — as ledger_cfg<N>_<tag>.json there; feed a pair to
-scripts/diff_runs.py to pinpoint the first divergent move between runs).
+scripts/diff_runs.py to pinpoint the first divergent move between runs),
+BENCH_INCREMENTAL=0 to skip the incremental-lane stage.
+
+Incremental-lane stage (PR 20, non-config-4 stages): after the timed pass,
+the bench arms the incremental lane (analyzer/incremental.py) on the solved
+model, kills one seeded broker, and times the lane's in-place re-proposal —
+typed deltas scattered into the warm device-resident context, goal-scoped
+re-solve seeded from the surviving placement, no model rebuild and no
+recompile. The compact line carries `incrementalReproposalS` (the lane's
+wall) and `incrementalDigestOk` (the lane's proposal must be
+provenance-digest-equal to a from-scratch solve of the SAME goal subset on
+the SAME perturbed model); scripts/perf_gate.py fails a false flag with its
+own exit code (6). The detail block records both walls, both digests, and
+the lane's delta/sensitivity summary.
 
 Each compact line also carries `provenanceDigest` — the 16-hex checksum of
 the run's canonical move list + per-goal cost deltas (the MoveLedger
@@ -432,6 +445,78 @@ def _default_options():
     return OptimizationOptions()
 
 
+def _incremental_block(optimizer, model, cfg_id, seed, result):
+    """Incremental-lane measurement (analyzer/incremental.py): arm the lane
+    on the model just solved, kill one seeded broker, and time the lane's
+    in-place re-proposal (delta scatter into the warm device context +
+    goal-scoped re-solve, no rebuild/recompile) against a from-scratch solve
+    of the SAME goal subset on the SAME perturbed model. The two runs must
+    be provenance-digest-equal — `incrementalDigestOk` rides the compact
+    line and scripts/perf_gate.py fails it with its own exit code (6).
+    Returns (payload_fields, detail_block); BENCH_INCREMENTAL=0 skips."""
+    import numpy as np
+
+    from cruise_control_tpu.analyzer.incremental import IncrementalLane
+    from cruise_control_tpu.common.resources import BrokerState
+
+    lane = IncrementalLane(optimizer)
+    names = tuple(g.name for g in result.goal_results)
+    if not lane.arm(model, _default_options(), names, generation=1):
+        log(f"[config {cfg_id}] incremental: lane failed to arm (prep cache miss)")
+        return {"incrementalDigestOk": False}, {"incremental": {"armed": False}}
+
+    state = np.asarray(model.broker_state).copy()
+    alive = np.nonzero(state == BrokerState.ALIVE)[0]
+    victim = int(alive[seed % alive.size])
+    state[victim] = BrokerState.DEAD
+    perturbed = model._replace(broker_state=state)
+    log(f"[config {cfg_id}] incremental: killing broker {victim}, re-proposing")
+
+    t0 = time.monotonic()
+    out = lane.propose(perturbed, generation=2)
+    inc_wall = time.monotonic() - t0
+    block = {"summary": out.summary(), "incrementalWallS": round(inc_wall, 3),
+             "victimBroker": victim}
+    if not out.ok:
+        # a broker death must stay in-lane; a fallback here is a regression
+        log(f"[config {cfg_id}] incremental: FELL BACK ({out.fallback_reason})")
+        return (
+            {"incrementalReproposalS": round(inc_wall, 3),
+             "incrementalDigestOk": False},
+            {"incremental": block},
+        )
+
+    t0 = time.monotonic()
+    scratch = optimizer.optimizations(
+        perturbed, goal_names=list(out.affected), options=_default_options(),
+        raise_on_hard_failure=False,
+    )
+    scratch_wall = time.monotonic() - t0
+    inc_digest = out.result.provenance.digest()["checksum"] \
+        if out.result.provenance else None
+    scr_digest = scratch.provenance.digest()["checksum"] \
+        if scratch.provenance else None
+    digest_ok = inc_digest is not None and inc_digest == scr_digest
+    ratio = inc_wall / max(scratch_wall, 1e-9)
+    log(
+        f"[config {cfg_id}] incremental: {inc_wall:.3f}s vs scratch "
+        f"{scratch_wall:.3f}s ({ratio:.1%}), digest "
+        f"{inc_digest} vs {scr_digest} ok={digest_ok}"
+    )
+    block.update({
+        "scratchWallS": round(scratch_wall, 3),
+        "reproposalVsScratch": round(ratio, 4),
+        "incrementalDigest": inc_digest,
+        "scratchDigest": scr_digest,
+        "digestOk": digest_ok,
+    })
+    return (
+        {"incrementalReproposalS": round(inc_wall, 3),
+         "incrementalDigestOk": digest_ok},
+        {"incremental": block},
+    )
+
+
 def _compile_counters() -> dict:
     """Process-wide compile/program-cache counters (sensors from the
     optimizer's program cache): the raw material of the compile-amortization
@@ -650,6 +735,16 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
             "CpuUsageDistributionGoal",
         ]
     wall, result = _timed(optimizer, model, cfg_id, "batched", goal_names=goal_names)
+    inc_fields: dict = {}
+    inc_detail: dict = {}
+    if os.environ.get("BENCH_INCREMENTAL", "1") != "0":
+        try:
+            inc_fields, inc_detail = _incremental_block(
+                optimizer, model, cfg_id, seed, result
+            )
+        except Exception:
+            log(f"[config {cfg_id}] incremental stage FAILED:\n{traceback.format_exc()}")
+            inc_fields = {"incrementalDigestOk": False}
     mesh_label = f"mesh-{mesh.size}, " if cfg_id == 6 else ""
     payload = {
         "metric": (
@@ -680,6 +775,8 @@ def run_config(cfg_id: int, seed: int, platform: str, parity: bool, mesh,
         "collectives": _collectives_block(),
         **({"provenance": prov_block} if prov_block else {}),
     }
+    payload.update(inc_fields)
+    detail.update(inc_detail)
     payload["collectiveOpsPerRound"] = detail["collectives"]["perRoundOps"]
     payload["programsCompiled"] = _compile_counters()["programs"]
     payload["compileSTotal"] = _compile_counters()["compileS"]

@@ -1,0 +1,728 @@
+# cclint: kernel-module
+"""Online incremental rebalancing: in-place model deltas + goal-scoped re-solve.
+
+Every proposal in the base pipeline rebuilds the cluster model from scratch
+and re-solves all goals from zero — tens of seconds exactly when the cluster
+is degraded and the detector's `ProposalDriftAnomaly` recompute is queued.
+This module is the recovery lane that avoids the rebuild:
+
+  1. `derive_deltas` diffs the monitor's fresh model against the model the
+     last full solve ran on and emits a typed `ModelDelta` stream (broker
+     death/revival, topic delete, partition add, load spike). Structural
+     changes a row-scatter cannot express (capacity edits, dense shifts
+     after a topic delete, axis growth past the shape bucket) become
+     fallback reasons instead of deltas.
+  2. `apply_delta_batch` scatters the batch INTO the device-resident padded
+     `StaticCtx` captured through the `GoalOptimizer._prep_cache` seam —
+     masked `.at[].set(mode="drop")` updates into the flat arrays, no
+     rebuild, no host round-trip per delta, and no recompile as long as the
+     shape bucket holds. The scatter recomputes exactly the state-derived
+     rows `build_static_ctx` derives (alive/dead/new/demoted and the
+     destination-eligibility masks), so the updated context is bitwise
+     equal to a from-scratch build on the perturbed model — that identity
+     is what makes the digest contract below checkable.
+  3. `SENSITIVITY` classifies which goals each delta kind can actually
+     violate (a pure load spike cannot violate Rack/ReplicaCount goals), so
+     `IncrementalLane.propose` re-solves only the affected goal subset —
+     riding the full-stack machine's runtime enabled mask
+     (`_machine_goal_plan`), seeded from the surviving placement.
+
+Correctness contract (machine-checked in tests/test_incremental.py and
+gated by scripts/perf_gate.py): for any goal subset the sensitivity map
+marks unaffected, the incremental solve makes ZERO moves — and a scoped
+solve of the affected subset is provenance-digest-equal (PR-8 ledger) to a
+from-scratch solve of the same subset on the same perturbed model, because
+both run literally the same `_solve_prepared` code on bit-identical inputs.
+
+The lane NEVER guesses: any delta it cannot express in place (or a stale
+generation, or an unarmed lane) is a typed fallback reason, and the facade
+falls back to the full re-solve when `optimizer.incremental.fallback.full`
+is on (docs/RESILIENCE.md failure matrix).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cruise_control_tpu.analyzer.context import OptimizationOptions, StaticCtx
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.common.tracing import TRACER
+from cruise_control_tpu.models.flat_model import FlatClusterModel
+
+# -- delta vocabulary ----------------------------------------------------------
+
+#: host-level delta kinds (the typed stream `derive_deltas` emits)
+DELTA_BROKER_DEATH = "broker_death"
+DELTA_BROKER_REVIVAL = "broker_revival"
+DELTA_BROKER_STATE = "broker_state"  # NEW/DEMOTED transitions
+DELTA_LOAD_SPIKE = "load_spike"
+DELTA_PART_ADD = "part_add"
+DELTA_TOPIC_DELETE = "topic_delete"
+
+DELTA_KINDS = (
+    DELTA_BROKER_DEATH,
+    DELTA_BROKER_REVIVAL,
+    DELTA_BROKER_STATE,
+    DELTA_LOAD_SPIKE,
+    DELTA_PART_ADD,
+    DELTA_TOPIC_DELETE,
+)
+
+#: kernel kind codes (DeltaBatch.kind); every broker-state transition shares
+#: one code — the scatter recomputes all state-derived rows regardless
+KIND_NOOP = 0
+KIND_STATE = 1
+KIND_LOAD = 2
+KIND_PART_ADD = 3
+
+_KERNEL_KIND = {
+    DELTA_BROKER_DEATH: KIND_STATE,
+    DELTA_BROKER_REVIVAL: KIND_STATE,
+    DELTA_BROKER_STATE: KIND_STATE,
+    DELTA_LOAD_SPIKE: KIND_LOAD,
+    DELTA_PART_ADD: KIND_PART_ADD,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelDelta:
+    """One typed model change, derived from monitor sample generations.
+
+    Field use by kind: broker-state kinds carry (broker, state); load spikes
+    carry (row, load) — the fresh model's EXACT f32 row, a replacement
+    rather than a multiplier so the scattered row is bitwise equal to a
+    from-scratch build; part adds carry (row, topic, load) and activate a
+    padded row; topic deletes carry only the kind (never applied in place —
+    the dense shift breaks row identity, see SENSITIVITY)."""
+
+    kind: str
+    broker: int = -1
+    state: int = -1
+    row: int = -1
+    topic: int = -1
+    load: Optional[np.ndarray] = None  # f32[M]
+
+    def __post_init__(self):
+        if self.kind not in DELTA_KINDS:
+            raise ValueError(f"unknown delta kind {self.kind!r}")
+
+
+class DeltaBatch(NamedTuple):
+    """Fixed-shape device form of a delta list: padded to `max_deltas` rows
+    with KIND_NOOP so every batch size shares ONE compiled scatter kernel."""
+
+    kind: jax.Array  # i32[D]
+    broker: jax.Array  # i32[D]
+    state: jax.Array  # i32[D]
+    row: jax.Array  # i32[D]
+    topic: jax.Array  # i32[D]
+    load: jax.Array  # f32[D, M]
+
+
+def build_delta_batch(
+    deltas: Sequence[ModelDelta], max_deltas: int, num_metrics: int
+) -> DeltaBatch:
+    """Pack host deltas into the fixed-shape batch (NOOP-padded)."""
+    d = max_deltas
+    kind = np.zeros(d, np.int32)
+    broker = np.zeros(d, np.int32)
+    state = np.zeros(d, np.int32)
+    row = np.zeros(d, np.int32)
+    topic = np.zeros(d, np.int32)
+    load = np.zeros((d, num_metrics), np.float32)
+    for i, dl in enumerate(deltas):
+        kind[i] = _KERNEL_KIND[dl.kind]
+        broker[i] = dl.broker
+        state[i] = dl.state
+        row[i] = dl.row
+        topic[i] = dl.topic
+        if dl.load is not None:
+            load[i] = np.asarray(dl.load, dtype=np.float32)  # cclint: disable=tpu-host-sync -- host-side batch packing of ModelDelta payloads (pure numpy in, jnp out at the return)
+    return DeltaBatch(
+        kind=jnp.asarray(kind),
+        broker=jnp.asarray(broker),
+        state=jnp.asarray(state),
+        row=jnp.asarray(row),
+        topic=jnp.asarray(topic),
+        load=jnp.asarray(load),
+    )
+
+
+# -- the in-place scatter kernel -----------------------------------------------
+
+
+def apply_delta_batch(
+    static: StaticCtx,
+    batch: DeltaBatch,
+    base_replica_dst: jax.Array,
+    base_leadership_dst: jax.Array,
+) -> StaticCtx:
+    """Scatter a delta batch into the device-resident StaticCtx.
+
+    Bit-identity contract with `build_static_ctx` (context.py): for every
+    delta kind this kernel applies, the returned context equals — array for
+    array, bit for bit — a from-scratch build on the equivalently-perturbed
+    host model. The state-derived rows are recomputed with the SAME
+    expressions build_static_ctx uses (`alive = (state != DEAD) & valid`,
+    destination masks `alive & base`), where `base_replica_dst` /
+    `base_leadership_dst` are the state-INDEPENDENT factors of the
+    destination masks (valid & not-excluded [& requested]) the lane
+    precomputes at arm time. Capacity, rack/host topology, and the
+    constraint scalars never change under these kinds (structural edits are
+    fallbacks), so every other field passes through untouched — and stays
+    resident on device.
+
+    Writes are routed out of bounds for non-matching kinds and dropped
+    (`mode="drop"`), so one fixed-shape program serves every batch. No
+    donation: the input arrays are shared with the optimizer's prep cache.
+    """
+    b = static.broker_state.shape[0]
+    p = static.part_load.shape[0]
+    is_state = batch.kind == KIND_STATE
+    is_load = (batch.kind == KIND_LOAD) | (batch.kind == KIND_PART_ADD)
+    is_add = batch.kind == KIND_PART_ADD
+
+    b_idx = jnp.where(is_state, batch.broker, b)
+    state = static.broker_state.at[b_idx].set(batch.state, mode="drop")
+    valid = static.broker_valid
+    alive = (state != BrokerState.DEAD) & valid
+    demoted = (state == BrokerState.DEMOTED) & valid
+
+    r_idx = jnp.where(is_load, batch.row, p)
+    part_load = static.part_load.at[r_idx].set(batch.load, mode="drop")
+    t_idx = jnp.where(is_add, batch.row, p)
+    topic_id = static.topic_id.at[t_idx].set(batch.topic, mode="drop")
+    # f32 addition of small integer counts is exact, so this matches
+    # build_static_ctx's jnp.float32(valid_partitions) bit for bit
+    nvp = static.num_valid_partitions + jnp.sum(is_add).astype(jnp.float32)
+
+    return static._replace(
+        broker_state=state,
+        alive=alive,
+        dead=(state == BrokerState.DEAD) & valid,
+        new=(state == BrokerState.NEW) & valid,
+        demoted=demoted,
+        replica_dst_ok=alive & base_replica_dst,
+        leadership_dst_ok=alive & ~demoted & base_leadership_dst,
+        part_load=part_load,
+        topic_id=topic_id,
+        num_valid_partitions=nvp,
+    )
+
+
+#: module-level so the compiled scatter survives across lane instances
+_jit_apply_delta_batch = jax.jit(apply_delta_batch)
+
+
+# -- delta derivation ----------------------------------------------------------
+
+#: fallback reason vocabulary (docs/RESILIENCE.md failure matrix)
+FALLBACK_DISABLED = "DISABLED"
+FALLBACK_NOT_ARMED = "NOT_ARMED"
+FALLBACK_STALE_GENERATION = "STALE_GENERATION"
+FALLBACK_SHAPE_RF = "SHAPE_RF"
+FALLBACK_SHAPE_BROKERS = "SHAPE_BROKERS"
+FALLBACK_SHAPE_BUCKET = "SHAPE_BUCKET"
+FALLBACK_SHAPE_TOPICS = "SHAPE_TOPICS"
+FALLBACK_STRUCTURAL = "STRUCTURAL"
+FALLBACK_STRUCTURAL_SHIFT = "STRUCTURAL_SHIFT"
+FALLBACK_TOO_MANY_DELTAS = "TOO_MANY_DELTAS"
+FALLBACK_SENSITIVITY_ALL = "SENSITIVITY_ALL"
+FALLBACK_OPTIONS = "OPTIONS"
+FALLBACK_NO_DELTAS = "NO_DELTAS"
+
+
+def derive_deltas(
+    old: FlatClusterModel, new: FlatClusterModel
+) -> Tuple[List[ModelDelta], Optional[str]]:
+    """Diff two UNPADDED monitor models into a typed delta stream.
+
+    Returns (deltas, fallback_reason): a non-None reason means the change
+    cannot be expressed as in-place row scatters (shape or structural
+    drift) and the caller must fall back to the full re-solve. Host-side
+    numpy; the models are the monitor's host builds, not device arrays.
+    `TopologyFingerprint.diff` (executor/validation.py) classifies the same
+    drifts for the dispatch guard — this is the model-array-level twin."""
+    if new.max_replication_factor != old.max_replication_factor:
+        return [], FALLBACK_SHAPE_RF
+    if new.num_brokers != old.num_brokers:
+        return [], FALLBACK_SHAPE_BROKERS
+    cap_o = np.asarray(old.broker_capacity)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    cap_n = np.asarray(new.broker_capacity)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    if (
+        not np.array_equal(cap_o, cap_n)
+        or not np.array_equal(np.asarray(old.broker_rack), np.asarray(new.broker_rack))  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+        or not np.array_equal(np.asarray(old.broker_host), np.asarray(new.broker_host))  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    ):
+        return [], FALLBACK_STRUCTURAL
+
+    p_old, p_new = old.num_partitions, new.num_partitions
+    if p_new < p_old:
+        # a topic delete dense-shifts every later partition row: row
+        # identity is gone, no scatter can express it. Emit the typed
+        # marker; SENSITIVITY maps it to "all" and the lane falls back.
+        return [ModelDelta(kind=DELTA_TOPIC_DELETE)], None
+    tid_o = np.asarray(old.topic_id)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    tid_n = np.asarray(new.topic_id)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    if not np.array_equal(tid_o, tid_n[:p_old]):
+        return [], FALLBACK_STRUCTURAL_SHIFT
+
+    deltas: List[ModelDelta] = []
+    st_o = np.asarray(old.broker_state)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    st_n = np.asarray(new.broker_state)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    for b in np.nonzero(st_o != st_n)[0]:
+        ns = int(st_n[b])  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+        if ns == BrokerState.DEAD:
+            kind = DELTA_BROKER_DEATH
+        elif int(st_o[b]) == BrokerState.DEAD:  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+            kind = DELTA_BROKER_REVIVAL
+        else:
+            kind = DELTA_BROKER_STATE
+        deltas.append(ModelDelta(kind=kind, broker=int(b), state=ns))
+
+    pl_o = np.asarray(old.part_load)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    pl_n = np.asarray(new.part_load)  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+    # row replacement, not a multiplier: `old * (new/old)` is not bitwise
+    # `new` in f32, and the digest contract needs bitwise
+    for r in np.nonzero(np.any(pl_o != pl_n[:p_old], axis=1))[0]:
+        deltas.append(ModelDelta(kind=DELTA_LOAD_SPIKE, row=int(r), load=pl_n[r]))
+    for r in range(p_old, p_new):
+        deltas.append(
+            ModelDelta(
+                kind=DELTA_PART_ADD, row=r, topic=int(tid_n[r]), load=pl_n[r]  # cclint: disable=tpu-host-sync -- derive_deltas diffs HOST monitor models by documented contract; no device array reaches it
+            )
+        )
+    return deltas, None
+
+
+# -- goal sensitivity ----------------------------------------------------------
+
+#: sentinel: the delta cannot be scoped (or expressed) — fall back to full
+ALL = "all"
+
+_COUNT_GOALS = frozenset(
+    (
+        "RackAwareGoal",
+        "ReplicaCapacityGoal",
+        "ReplicaDistributionGoal",
+        "TopicReplicaDistributionGoal",
+        "LeaderReplicaDistributionGoal",
+    )
+)
+_LOAD_GOALS = frozenset(
+    (
+        "DiskCapacityGoal",
+        "NetworkInboundCapacityGoal",
+        "NetworkOutboundCapacityGoal",
+        "CpuCapacityGoal",
+        "PotentialNwOutGoal",
+        "DiskUsageDistributionGoal",
+        "NetworkInboundUsageDistributionGoal",
+        "NetworkOutboundUsageDistributionGoal",
+        "CpuUsageDistributionGoal",
+        "LeaderBytesInDistributionGoal",
+    )
+)
+
+
+def _sensitivity_map() -> Dict[str, object]:
+    from cruise_control_tpu.analyzer.goals import HARD_GOAL_NAMES, GOAL_REGISTRY
+
+    all_names = frozenset(GOAL_REGISTRY)
+    return {
+        # a pure load change moves no replica and kills no broker: the five
+        # count/placement goals (rack spread, replica counts) see the exact
+        # same assignment and cannot become violated
+        DELTA_LOAD_SPIKE: _LOAD_GOALS,
+        # a broker death strands replicas: every goal window changes (the
+        # dead broker leaves `alive`), so the whole armed stack re-solves —
+        # still IN-LANE (warm program + surviving placement), just unscoped
+        DELTA_BROKER_DEATH: all_names,
+        DELTA_BROKER_STATE: all_names,
+        # a revived broker re-enters empty-handed: it cannot push any HARD
+        # goal into violation (capacity/rack checks only bind brokers that
+        # HOLD replicas); only the soft distribution goals want to use it
+        DELTA_BROKER_REVIVAL: all_names - frozenset(HARD_GOAL_NAMES),
+        # an added partition lands with observed load already accounted in
+        # its LOAD row (derive_deltas emits part_add rows with the fresh
+        # load); the new row changes counts and placement windows
+        DELTA_PART_ADD: _COUNT_GOALS,
+        # not expressible in place (dense row shift) — forces the fallback
+        DELTA_TOPIC_DELETE: ALL,
+    }
+
+
+SENSITIVITY: Dict[str, object] = _sensitivity_map()
+
+
+def affected_goals(
+    deltas: Sequence[ModelDelta], armed_goal_names: Sequence[str]
+) -> Optional[Tuple[str, ...]]:
+    """The armed-order goal subset this batch can violate; None = ALL
+    (sensitivity cannot scope the batch — fall back)."""
+    union: set = set()
+    for d in deltas:
+        sens = SENSITIVITY[d.kind]
+        if sens == ALL:
+            return None
+        union |= set(sens)
+    return tuple(n for n in armed_goal_names if n in union)
+
+
+# -- configuration -------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class IncrementalConfig:
+    """`optimizer.incremental.*` knobs (config/cruise_config.py)."""
+
+    enabled: bool = True
+    max_deltas: int = 64
+    fallback_full: bool = True
+
+    @classmethod
+    def from_config(cls, config) -> "IncrementalConfig":
+        return cls(
+            enabled=config.get_boolean("optimizer.incremental.enabled"),
+            max_deltas=config.get_int("optimizer.incremental.max.deltas"),
+            fallback_full=config.get_boolean("optimizer.incremental.fallback.full"),
+        )
+
+
+# -- outcome + lane ------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class IncrementalOutcome:
+    """One propose() attempt: either a scoped OptimizerResult or a typed
+    fallback reason the facade routes to the full re-solve."""
+
+    result: Optional[object]  # OptimizerResult
+    deltas: List[ModelDelta]
+    affected: Tuple[str, ...]
+    goals_skipped: int
+    fallback_reason: Optional[str]
+    duration_s: float
+
+    @property
+    def ok(self) -> bool:
+        return self.result is not None
+
+    def summary(self) -> Dict:
+        by_kind: Dict[str, int] = {}
+        for d in self.deltas:
+            by_kind[d.kind] = by_kind.get(d.kind, 0) + 1
+        return {
+            "ok": self.ok,
+            "deltas": len(self.deltas),
+            "deltasByKind": by_kind,
+            "affectedGoals": list(self.affected),
+            "goalsSkipped": self.goals_skipped,
+            "fallbackReason": self.fallback_reason,
+            "durationS": round(self.duration_s, 4),
+        }
+
+
+@dataclasses.dataclass
+class _ArmedState:
+    """What the lane captured from the last stamped full solve."""
+
+    model: FlatClusterModel  # the UNPADDED host model that solve ran on
+    options: OptimizationOptions
+    goal_names: Tuple[str, ...]
+    generation: Optional[int]
+    p_valid: int  # real partitions (grows with part_add deltas)
+    pmodel: FlatClusterModel  # padded HOST copy, kept delta-consistent
+    dims: object
+    static: StaticCtx  # device-resident (mesh-placed when sharded)
+    static_canon: StaticCtx  # unsharded canonical copy the kernel updates
+    bucketed: Dict
+    base_replica_dst: np.ndarray  # bool[B] state-independent dst factor
+    base_leadership_dst: np.ndarray  # bool[B]
+
+
+class IncrementalLane:
+    """The incremental re-proposal lane over one GoalOptimizer.
+
+    `arm()` after every stamped full solve captures the prep-cache entry of
+    that solve (padded model + device StaticCtx + bucket record);
+    `propose()` then turns a fresh monitor model into a scoped re-solve in
+    milliseconds-to-one-device-call instead of a full rebuild. Thread-safe
+    the same way the facade's proposal cache is (one lock, short critical
+    sections; the solve itself runs outside the lock on the optimizer's own
+    locking discipline)."""
+
+    def __init__(self, optimizer, config: IncrementalConfig = IncrementalConfig()):
+        self._optimizer = optimizer
+        self._config = config
+        self._lock = threading.Lock()
+        self._armed: Optional[_ArmedState] = None
+        self._last: Optional[IncrementalOutcome] = None
+        self._goals_skipped = 0
+        REGISTRY.gauge("Incremental.goals-skipped", lambda: self._goals_skipped)
+
+    @property
+    def config(self) -> IncrementalConfig:
+        return self._config
+
+    # -- arming ----------------------------------------------------------------
+
+    def arm(
+        self,
+        model: FlatClusterModel,
+        options: OptimizationOptions,
+        goal_names: Sequence[str],
+        generation: Optional[int] = None,
+    ) -> bool:
+        """Capture the prep-cache entry of a just-completed full solve.
+
+        Must be called with the SAME (model, options) objects that solve
+        used — the prep cache keys by identity. Returns False (lane stays
+        unarmed/previous) when disabled or when the entry was evicted."""
+        if not self._config.enabled:
+            return False
+        prepared_entry = getattr(self._optimizer, "prepared_entry", None)
+        if prepared_entry is None:
+            # Optimizer without a prep cache (e.g. a test double): the lane
+            # simply never arms and every propose() falls back to a full solve.
+            return False
+        entry = prepared_entry(model, options)
+        if entry is None:
+            return False
+        p_orig, pmodel, dims, static, static_canon, bucketed = entry
+        b = dims.num_brokers
+        valid = np.arange(b) < model.num_brokers
+
+        def padded(mask):
+            if mask is None:
+                return None
+            m = np.asarray(mask, dtype=bool)  # cclint: disable=tpu-host-sync -- arm-time mask padding over HOST option arrays (off the proposal hot path)
+            return np.concatenate([m, np.zeros(b - m.shape[0], dtype=bool)])
+
+        base_replica = valid.copy()
+        excl_rep = padded(options.excluded_brokers_for_replica_move)
+        if excl_rep is not None:
+            base_replica &= ~excl_rep
+        req = padded(options.requested_destination_brokers)
+        if req is not None:
+            base_replica &= req
+        base_lead = valid.copy()
+        excl_lead = padded(options.excluded_brokers_for_leadership)
+        if excl_lead is not None:
+            base_lead &= ~excl_lead
+
+        host_pmodel = FlatClusterModel(*(np.asarray(f) for f in pmodel))  # cclint: disable=tpu-host-sync -- deliberate one-time d2h at arm time: the lane keeps a host twin of the padded model
+        with self._lock:
+            self._armed = _ArmedState(
+                model=model,
+                options=options,
+                goal_names=tuple(goal_names),
+                generation=generation,
+                p_valid=p_orig,
+                pmodel=host_pmodel,
+                dims=dims,
+                static=static,
+                static_canon=static_canon,
+                bucketed=dict(bucketed),
+                base_replica_dst=base_replica,
+                base_leadership_dst=base_lead,
+            )
+        REGISTRY.meter("Incremental.lane-armed").mark()
+        return True
+
+    # -- proposing -------------------------------------------------------------
+
+    def propose(
+        self,
+        new_model: FlatClusterModel,
+        generation: Optional[int] = None,
+        progress=None,
+    ) -> IncrementalOutcome:
+        """Derive deltas vs the armed model, scatter them in place, and
+        re-solve the sensitivity-affected goal subset. Never raises on a
+        lane miss — every ineligibility is a typed fallback outcome."""
+        t0 = time.monotonic()
+        if not self._config.enabled:
+            return self._fallback([], FALLBACK_DISABLED, t0)
+        with self._lock:
+            armed = self._armed
+        if armed is None:
+            return self._fallback([], FALLBACK_NOT_ARMED, t0)
+        if (
+            generation is not None
+            and armed.generation is not None
+            and generation < armed.generation
+        ):
+            return self._fallback([], FALLBACK_STALE_GENERATION, t0)
+
+        deltas, reason = derive_deltas(armed.model, new_model)
+        if reason is not None:
+            return self._fallback(deltas, reason, t0)
+        if not deltas:
+            return self._fallback(deltas, FALLBACK_NO_DELTAS, t0)
+        if len(deltas) > self._config.max_deltas:
+            return self._fallback(deltas, FALLBACK_TOO_MANY_DELTAS, t0)
+        reason = self._eligibility(armed, deltas, new_model)
+        if reason is not None:
+            return self._fallback(deltas, reason, t0)
+        affected = affected_goals(deltas, armed.goal_names)
+        if affected is None:
+            return self._fallback(deltas, FALLBACK_SENSITIVITY_ALL, t0)
+
+        with TRACER.span(
+            "incremental-delta-apply", kind="incremental",
+            deltas=len(deltas), goals=len(affected),
+        ):
+            dims = armed.dims
+            batch = build_delta_batch(
+                deltas, self._config.max_deltas, armed.pmodel.part_load.shape[1]
+            )
+            new_canon = _jit_apply_delta_batch(
+                armed.static_canon, batch,
+                jnp.asarray(armed.base_replica_dst),
+                jnp.asarray(armed.base_leadership_dst),
+            )
+            if self._optimizer._mesh is not None:
+                from cruise_control_tpu.parallel.sharding import place_static
+
+                new_static = place_static(new_canon, self._optimizer._mesh)
+            else:
+                new_static = new_canon
+            pmodel = self._updated_pmodel(armed, deltas, new_model)
+        p_valid = new_model.num_partitions
+
+        result = self._optimizer.incremental_optimizations(
+            pmodel, dims, new_static, new_canon,
+            dict(armed.bucketed, incremental=True),
+            p_orig=p_valid, goal_names=affected,
+            raise_on_hard_failure=False, progress=progress,
+        )
+
+        skipped = len(armed.goal_names) - len(affected)
+        with self._lock:
+            self._armed = dataclasses.replace(
+                armed,
+                model=new_model,
+                generation=generation if generation is not None else armed.generation,
+                p_valid=p_valid,
+                pmodel=pmodel,
+                static=new_static,
+                static_canon=new_canon,
+            )
+            self._goals_skipped = skipped
+        REGISTRY.meter("Incremental.deltas-applied").mark(len(deltas))
+        for d in deltas:
+            REGISTRY.meter(f"Incremental.deltas-applied.{d.kind}").mark()
+        duration = time.monotonic() - t0
+        REGISTRY.histogram("Incremental.reproposal-timer").record(duration)
+        outcome = IncrementalOutcome(
+            result=result,
+            deltas=deltas,
+            affected=affected,
+            goals_skipped=skipped,
+            fallback_reason=None,
+            duration_s=duration,
+        )
+        with self._lock:
+            self._last = outcome
+        return outcome
+
+    def _eligibility(
+        self, armed: _ArmedState, deltas: Sequence[ModelDelta],
+        new_model: FlatClusterModel,
+    ) -> Optional[str]:
+        """Shape-bucket + options checks the padded context imposes."""
+        dims = armed.dims
+        for d in deltas:
+            if d.kind == DELTA_PART_ADD:
+                if d.row >= dims.num_partitions:
+                    return FALLBACK_SHAPE_BUCKET
+                if d.topic >= dims.num_topics:
+                    return FALLBACK_SHAPE_TOPICS
+                if armed.options.excluded_partitions is not None:
+                    # the padded exclusion mask marked pad rows excluded; an
+                    # activated pad row would need a mask rebuild
+                    return FALLBACK_OPTIONS
+        return None
+
+    def _updated_pmodel(
+        self, armed: _ArmedState, deltas: Sequence[ModelDelta],
+        new_model: FlatClusterModel,
+    ) -> FlatClusterModel:
+        """Host twin of the device scatter: the padded model copy the solve
+        computes stats/proposals from, kept bit-consistent with the kernel
+        by applying the SAME row writes (plus the fresh assignment, which
+        is always taken whole — the solve seeds from the live placement)."""
+        pm = armed.pmodel
+        part_load = pm.part_load.copy()
+        topic_id = pm.topic_id.copy()
+        broker_state = pm.broker_state.copy()
+        for d in deltas:
+            code = _KERNEL_KIND[d.kind]
+            if code == KIND_STATE:
+                broker_state[d.broker] = d.state
+            elif code in (KIND_LOAD, KIND_PART_ADD):
+                part_load[d.row] = np.asarray(d.load, dtype=np.float32)  # cclint: disable=tpu-host-sync -- host twin of the device scatter by design (see docstring); pure numpy rows
+                if code == KIND_PART_ADD:
+                    topic_id[d.row] = d.topic
+        target_p, rf = pm.assignment.shape
+        fresh = np.asarray(new_model.assignment)  # cclint: disable=tpu-host-sync -- host twin of the device scatter by design (see docstring); pure numpy rows
+        assignment = np.concatenate(
+            [fresh, np.full((target_p - fresh.shape[0], rf), -1, dtype=fresh.dtype)]
+        )
+        return pm._replace(
+            assignment=assignment,
+            part_load=part_load,
+            topic_id=topic_id,
+            broker_state=broker_state,
+        )
+
+    def _fallback(
+        self, deltas: List[ModelDelta], reason: str, t0: float
+    ) -> IncrementalOutcome:
+        REGISTRY.meter("Incremental.fallback-to-full").mark()
+        REGISTRY.meter(f"Incremental.fallback-to-full.{reason}").mark()
+        outcome = IncrementalOutcome(
+            result=None,
+            deltas=deltas,
+            affected=(),
+            goals_skipped=0,
+            fallback_reason=reason,
+            duration_s=time.monotonic() - t0,
+        )
+        with self._lock:
+            self._last = outcome
+        return outcome
+
+    # -- introspection ---------------------------------------------------------
+
+    def state(self) -> Dict:
+        """The `/state` IncrementalState block (facade.state())."""
+        with self._lock:
+            armed = self._armed
+            last = self._last
+        return {
+            "enabled": self._config.enabled,
+            "maxDeltas": self._config.max_deltas,
+            "fallbackFull": self._config.fallback_full,
+            "armed": armed is not None,
+            **(
+                {
+                    "generation": armed.generation,
+                    "goals": list(armed.goal_names),
+                    "bucket": armed.bucketed.get("bucket"),
+                    "validPartitions": armed.p_valid,
+                }
+                if armed is not None
+                else {}
+            ),
+            "lastOutcome": last.summary() if last is not None else None,
+        }

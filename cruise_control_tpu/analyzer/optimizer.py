@@ -1687,24 +1687,40 @@ class GoalOptimizer:
             # a prep miss is the upload of every static model array; the hit
             # path moves nothing (that asymmetry is what the h2d meter shows)
             TELEMETRY.record_transfer("h2d", tree_nbytes((pmodel, static)))
+        agg = self._initial_aggregates(pmodel, dims, static, static_canon)
+        return goals, p_orig, pmodel, dims, static, agg, bucketed
+
+    def _initial_aggregates(self, pmodel, dims: Dims, static, static_canon):
+        """Initial aggregates for a padded model (shared by _prepare and the
+        incremental lane — the one piece of prep that re-runs every call
+        because the optimizer DONATES its output)."""
         # the aggregates input re-uploads each call (its output is donated)
         TELEMETRY.record_transfer("h2d", tree_nbytes(pmodel.assignment))
         if self._mesh is None:
-            agg = _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
-        else:
-            # canonical initial aggregates: run the segment_sums on the
-            # UNSHARDED static + a single-device assignment so the reduce
-            # order is bit-identical to a mesh-None run, then place the
-            # result onto the mesh (pure layout, no arithmetic). See the
-            # _build_ctx note — this is half of the decision-identity
-            # contract (docs/SHARDING.md).
-            from cruise_control_tpu.parallel.sharding import place_aggregates
+            return _jit_compute_aggregates(static, jnp.asarray(pmodel.assignment), dims)
+        # canonical initial aggregates: run the segment_sums on the
+        # UNSHARDED static + a single-device assignment so the reduce
+        # order is bit-identical to a mesh-None run, then place the
+        # result onto the mesh (pure layout, no arithmetic). See the
+        # _build_ctx note — this is half of the decision-identity
+        # contract (docs/SHARDING.md).
+        from cruise_control_tpu.parallel.sharding import place_aggregates
 
-            agg = _jit_compute_aggregates(
-                static_canon, jnp.asarray(np.asarray(pmodel.assignment)), dims
-            )
-            agg = place_aggregates(agg, self._mesh)
-        return goals, p_orig, pmodel, dims, static, agg, bucketed
+        agg = _jit_compute_aggregates(
+            static_canon, jnp.asarray(np.asarray(pmodel.assignment)), dims
+        )
+        return place_aggregates(agg, self._mesh)
+
+    def prepared_entry(self, model: FlatClusterModel, options: OptimizationOptions):
+        """The cached prep-cache entry for (model, options), or None.
+
+        The incremental lane's seam (analyzer/incremental.py): after a full
+        solve, the lane captures the padded model, device-resident StaticCtx
+        and bucket record of that solve so later deltas can be scattered into
+        the SAME device arrays without a rebuild. Returns
+        (p_orig, pmodel, dims, static, static_canon, bucketed)."""
+        hit = self._prep_cache.get(self._prepare_key(model, options))
+        return None if hit is None else hit[:6]
 
     @staticmethod
     def _prepare_key(model: FlatClusterModel, options: OptimizationOptions):
@@ -1953,6 +1969,50 @@ class GoalOptimizer:
         HISTORY.record_boundary("proposal")
         return result
 
+    def incremental_optimizations(
+        self,
+        pmodel: FlatClusterModel,
+        dims: Dims,
+        static,
+        static_canon,
+        bucketed,
+        p_orig: int,
+        goal_names: Optional[Sequence[str]] = None,
+        raise_on_hard_failure: bool = False,
+        progress=None,
+    ) -> OptimizerResult:
+        """Solve an ALREADY-PREPARED padded model: the incremental lane's
+        entry point (analyzer/incremental.py).
+
+        Skips `_prepare` entirely — the caller supplies the padded model and
+        a delta-updated StaticCtx whose shapes match a previously compiled
+        bucket, so the warm machine program is reused as-is; only the cheap
+        aggregates kernel re-runs (its output is donated). `goal_names` is
+        the sensitivity-affected subset: any subset of the default stack
+        rides the full-stack machine's runtime enabled mask
+        (_machine_goal_plan), so a goal-scoped re-solve costs zero compiles."""
+        with maybe_profile() as profiled, TRACER.span(
+            "incremental-proposal", kind="proposal",
+            brokers=int(dims.num_brokers),
+            partitions=int(dims.num_partitions),
+            goals=len(tuple(goal_names)) if goal_names is not None else -1,
+            profiled=bool(profiled),
+        ) as root:
+            t0 = time.monotonic()
+            goals = goals_by_priority(goal_names)
+            agg = self._initial_aggregates(pmodel, dims, static, static_canon)
+            result = self._solve_prepared(
+                goals, p_orig, pmodel, dims, static, agg, bucketed,
+                raise_on_hard_failure, progress, t0,
+            )
+            root.attributes.update(
+                numProposals=len(result.proposals),
+                replicaMoves=result.num_replica_moves,
+            )
+        TELEMETRY.update_memory()
+        HISTORY.record_boundary("proposal")
+        return result
+
     def _optimizations(
         self,
         model: FlatClusterModel,
@@ -1965,6 +2025,29 @@ class GoalOptimizer:
         goals, p_orig, model, dims, static, agg, bucketed = self._prepare(
             model, goal_names, options
         )
+        return self._solve_prepared(
+            goals, p_orig, model, dims, static, agg, bucketed,
+            raise_on_hard_failure, progress, t0,
+        )
+
+    def _solve_prepared(
+        self,
+        goals,
+        p_orig: int,
+        model: FlatClusterModel,
+        dims: Dims,
+        static,
+        agg,
+        bucketed,
+        raise_on_hard_failure: bool,
+        progress,
+        t0: float,
+    ) -> OptimizerResult:
+        """Back half of _optimizations: run the goal stack on a prepared
+        (padded, device-resident) model and diff placements. Shared verbatim
+        between the from-scratch path and incremental_optimizations — the
+        digest-equality contract between the two lanes rests on this being
+        literally the same code on the same machine program."""
         if not goals:
             # an explicitly empty goal list is a no-op, not an error (the
             # reference just runs zero optimize() calls); None means defaults

@@ -235,28 +235,41 @@ class RunLedger:
 
     # -- digests ---------------------------------------------------------------
 
-    def digest(self) -> Dict:
+    def digest(self, goals: Optional[Sequence[str]] = None) -> Dict:
         """Per-goal move counts + cost-delta checksum, plus a short hash of
         the full canonical move list — two runs with equal digests made the
         same decisions; a mismatch at equal parity is silent decision drift
-        (scripts/perf_gate.py's distinct exit path)."""
+        (scripts/perf_gate.py's distinct exit path).
+
+        `goals`: restrict the digest to moves ON these goals (the
+        incremental lane's unaffected-goal contract, analyzer/incremental.py:
+        an incremental re-solve and a from-scratch solve must agree on every
+        goal the sensitivity map marks unaffected). A goal-scoped digest
+        hashes move decisions only — per-goal cost deltas are EXCLUDED,
+        because a goal-scoped run never measures goals outside its subset
+        and the comparison must not depend on what one side didn't run."""
+        if goals is not None:
+            keep = set(goals)
+            moves = [m for m in self.moves if m.goal in keep]
+        else:
+            moves = self.moves
         by_goal: Dict[str, int] = {}
-        for m in self.moves:
+        for m in moves:
             by_goal[m.goal] = by_goal.get(m.goal, 0) + 1
         cost_delta = {
             s.goal: round(s.cost_delta, 6)
             for s in self.segments
             if s.phase == "main"
-        }
+        } if goals is None else {}
         h = hashlib.sha256()
-        for m in sorted(self.moves, key=MoveRecord.key):
+        for m in sorted(moves, key=MoveRecord.key):
             h.update("|".join(map(str, m.decision())).encode())
         for g in sorted(cost_delta):
             h.update(f"{g}={cost_delta[g]}".encode())
         return {
-            "moves": len(self.moves),
+            "moves": len(moves),
             "byGoal": by_goal,
-            "costDelta": cost_delta,
+            **({"costDelta": cost_delta} if goals is None else {"goals": sorted(keep)}),
             "checksum": h.hexdigest()[:16],
         }
 

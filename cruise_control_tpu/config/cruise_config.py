@@ -145,6 +145,19 @@ def _config_def() -> ConfigDef:
     d.define("optimizer.bucket.floor", Type.INT, 64, at_least(1), Importance.LOW,
              "Broker counts at or below this stay exact (no padding); tiny clusters "
              "recompile per shape but pay zero padding overhead.")
+    d.define("optimizer.incremental.enabled", Type.BOOLEAN, True, None, Importance.MEDIUM,
+             "Arm the incremental rebalancing lane after each proposal: model drift is "
+             "applied to the device-resident prepared context as in-place typed deltas "
+             "and only the sensitivity-affected goal subset is re-solved "
+             "(analyzer/incremental.py, docs/RESILIENCE.md).")
+    d.define("optimizer.incremental.max.deltas", Type.INT, 64, at_least(1), Importance.MEDIUM,
+             "Max typed deltas absorbed in one incremental re-proposal; larger drifts "
+             "fall back to a full from-scratch solve (the delta batch is padded to this "
+             "size, so it is also the scatter kernel's compiled batch shape).")
+    d.define("optimizer.incremental.fallback.full", Type.BOOLEAN, True, None, Importance.MEDIUM,
+             "When the incremental lane declines (shape bucket overflow, stale "
+             "generation, sensitivity says all goals, ...), transparently run the full "
+             "goal-violation rebalance instead of raising.")
     # --- monitor (windows/sampling; reference defaults in cruisecontrol.properties)
     d.define("partition.metrics.window.ms", Type.LONG, 300000, at_least(1), Importance.HIGH,
              "Width of one partition-metric aggregation window.")

@@ -89,14 +89,24 @@ class ProposalDriftAnomaly(Anomaly):
     """The executor aborted a proposal batch because the cluster drifted too
     far from the batch's model (generation skew past
     `executor.proposal.max.generation.skew`, docs/RESILIENCE.md). The stale
-    plan is gone; the fix is a fresh one — ride the goal-violation
-    self-healing path (same cache-bypassing rebalance a violated goal
-    triggers), so breakers, enables, and the busy-executor gate all apply."""
+    plan is gone; the fix is a fresh one — ride the INCREMENTAL lane
+    (analyzer/incremental.py): derive the drift as typed model deltas,
+    scatter them into the device-resident padded context of the last solve,
+    and re-solve only the sensitivity-affected goals, seeded from the
+    surviving placement. The facade falls back to the full goal-violation
+    rebalance (same cache-bypassing path a violated goal triggers) when the
+    lane reports a typed fallback reason, so breakers, enables, and the
+    busy-executor gate still apply on both lanes."""
 
     drift: Dict
     anomaly_type = AnomalyType.GOAL_VIOLATION
 
     def fix(self, facade):
+        incremental = getattr(facade, "incremental_reproposal", None)
+        if incremental is not None:
+            return incremental(dryrun=False)
+        # Facade without the incremental surface: ride the classic
+        # cache-bypassing goal-violation rebalance directly.
         from cruise_control_tpu.analyzer.context import OptimizationOptions
 
         return facade.rebalance(

@@ -18,6 +18,7 @@ import numpy as np
 
 from cruise_control_tpu.analyzer.context import OptimizationOptions
 from cruise_control_tpu.analyzer.goals import DEFAULT_GOAL_ORDER, GOAL_REGISTRY, HARD_GOAL_NAMES
+from cruise_control_tpu.analyzer.incremental import IncrementalConfig, IncrementalLane
 from cruise_control_tpu.analyzer.optimizer import (
     GoalOptimizer,
     OptimizerResult,
@@ -51,6 +52,9 @@ class FacadeConfig:
     #: goals used when a request names none — the reference's `default.goals`
     #: key (operators commonly trim the stack); None = the full priority order
     default_goal_names: Optional[Tuple[str, ...]] = None
+    #: incremental re-proposal lane knobs (`optimizer.incremental.*` keys,
+    #: analyzer/incremental.py)
+    incremental: IncrementalConfig = IncrementalConfig()
 
 
 class CruiseControl:
@@ -69,6 +73,10 @@ class CruiseControl:
         self._clock = clock
         self._cache_lock = threading.Lock()
         self._cached: Optional[_CachedProposals] = None
+        #: the incremental re-proposal lane, armed after every stamped full
+        #: solve and consulted by incremental_reproposal() (the detector's
+        #: ProposalDriftAnomaly recovery path)
+        self._incremental = IncrementalLane(self._optimizer, config.incremental)
 
     # -- goal resolution -------------------------------------------------------
 
@@ -234,6 +242,15 @@ class CruiseControl:
         if generation >= 0:
             result = self._attach_topic_names(result, _meta)
             result = self._stamp_result(result, generation, _topo)
+            # arm the incremental lane on the SAME (model, options) objects
+            # this solve prepared — the prep-cache seam keys by identity, so
+            # the lane captures the device-resident padded context of the
+            # solve that just ran (analyzer/incremental.py)
+            self._incremental.arm(
+                model, options,
+                tuple(g.name for g in result.goal_results),
+                generation=generation,
+            )
         if use_cache and generation >= 0:
             with self._cache_lock:
                 self._cached = _CachedProposals(result, generation, self._clock(), req)
@@ -257,6 +274,49 @@ class CruiseControl:
         if not dryrun:
             self._execute_result(result)
         return result
+
+    def incremental_reproposal(
+        self,
+        dryrun: bool = True,
+        requirements: Optional[ModelCompletenessRequirements] = None,
+    ) -> OptimizerResult:
+        """The recovery lane: fresh monitor model → typed delta stream →
+        in-place scatter into the device-resident padded context →
+        goal-scoped re-solve seeded from the surviving placement
+        (analyzer/incremental.py).
+
+        The detector's `ProposalDriftAnomaly` recompute (which the executor's
+        batch-abort path also queues) routes here instead of the full
+        rebalance. Any lane ineligibility — unarmed, stale generation, delta
+        out of the shape bucket, sensitivity map says all — falls back to
+        the full goal-violation re-solve when
+        `optimizer.incremental.fallback.full` is on, and raises otherwise
+        (the operator asked for incremental-or-nothing)."""
+        if not dryrun:
+            self._sanity_check_dry_run(dryrun)
+        req = requirements or self._config.default_requirements
+        with self._monitor.acquire_for_model_generation():
+            generation = self._monitor.generation
+            model, _meta = self._monitor.cluster_model(req)
+            _topo = self._monitor._metadata.refresh_metadata()
+        outcome = self._incremental.propose(model, generation=generation)
+        if outcome.ok:
+            result = outcome.result
+            result = self._attach_topic_names(result, _meta)
+            result = self._stamp_result(result, generation, _topo)
+            if not dryrun:
+                self._execute_result(result)
+            return result
+        if self._incremental.config.fallback_full:
+            return self.rebalance(
+                dryrun=dryrun,
+                options=OptimizationOptions(is_triggered_by_goal_violation=True),
+                ignore_proposal_cache=True,
+            )
+        raise RuntimeError(
+            f"incremental re-proposal unavailable: {outcome.fallback_reason} "
+            "(optimizer.incremental.fallback.full is off)"
+        )
 
     def decommission_brokers(
         self,
@@ -452,6 +512,7 @@ class CruiseControl:
                 "goals": [g.name for g in DEFAULT_GOAL_ORDER],
                 "cachedProposals": self._cached is not None,
             },
+            "IncrementalState": self._incremental.state(),
             # named timers/meters (Sensors.md; JMX domain kafka.cruisecontrol)
             "Sensors": REGISTRY.snapshot(),
         }

@@ -30,6 +30,9 @@ engine factories):
                             (parallel/spmd.py, batch_k=1 grid engine)
   spmd-partition-stats      the integer-psum shard-coverage stats kernel
                             (zero all-gathers allowed)
+  incremental-delta-apply   the in-place model-delta scatter of the
+                            incremental rebalancing lane
+                            (analyzer/incremental.py apply_delta_batch)
 
 Everything heavy is imported inside the builders: this module is imported
 by the trace worker subprocess only — the in-process linter merely scans
@@ -287,6 +290,31 @@ def _build_spmd_partition_stats():
     )
 
 
+def _build_incremental_delta_apply():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cruise_control_tpu.analyzer import incremental as inc
+    from cruise_control_tpu.common.resources import BrokerState
+
+    model, dims, _settings, static, _agg = _tiny_problem()
+    num_metrics = int(np.asarray(static.part_load).shape[1])
+    deltas = [
+        inc.ModelDelta(
+            kind=inc.DELTA_BROKER_DEATH, broker=0, state=int(BrokerState.DEAD)
+        ),
+        inc.ModelDelta(
+            kind=inc.DELTA_LOAD_SPIKE, row=1,
+            load=np.ones(num_metrics, np.float32),
+        ),
+    ]
+    batch = inc.build_delta_batch(deltas, max_deltas=8, num_metrics=num_metrics)
+    base = jnp.asarray(np.asarray(static.broker_valid, dtype=bool))
+    # NO donation: the kernel's inputs are shared with the optimizer's prep
+    # cache (apply_delta_batch docstring) — the trace tier checks that too
+    return dict(fn=inc.apply_delta_batch, args=(static, batch, base, base))
+
+
 CCLINT_TRACE_ENTRYPOINTS = [
     dict(name="fused-stack-step", build=_build_fused_stack),
     dict(name="chunked-goal-machine", build=_build_goal_machine),
@@ -297,4 +325,5 @@ CCLINT_TRACE_ENTRYPOINTS = [
     dict(name="sharded-compute-stats", build=_build_sharded_stats),
     dict(name="spmd-grid-shortlist", build=_build_spmd_grid_shortlist),
     dict(name="spmd-partition-stats", build=_build_spmd_partition_stats),
+    dict(name="incremental-delta-apply", build=_build_incremental_delta_apply),
 ]

@@ -33,8 +33,9 @@ def build_simulated_service(
     """Wire the full stack over a simulated cluster; returns (app, parts).
 
     `config_path`: optional cruisecontrol.properties — the analyzer keys
-    (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`
-    and the bulk count-planner knobs) map onto the goal engine through
+    (balancing thresholds, `optimizer.*` including `optimizer.polish.rounds`,
+    the bulk count-planner knobs, and the `optimizer.incremental.*` lane)
+    map onto the goal engine through
     BalancingConstraint.from_config / OptimizerSettings.from_config, the
     `observability.*` keys configure the span tracer (ring size, JSONL sink),
     arm the one-shot profiler capture, and shape the sensor time-series
@@ -86,10 +87,13 @@ def build_simulated_service(
         ),
     )
     runner = LoadMonitorTaskRunner(monitor)
+    from cruise_control_tpu.analyzer.incremental import IncrementalConfig
+
     optimizer = GoalOptimizer()
     executor_config = ExecutorConfig()
     notifier = SelfHealingNotifier()
     executor_notifier = None
+    incremental_config = IncrementalConfig()
     if config_path:
         from cruise_control_tpu.analyzer.optimizer import OptimizerSettings
         from cruise_control_tpu.config.balancing import BalancingConstraint
@@ -146,6 +150,9 @@ def build_simulated_service(
         from cruise_control_tpu.analyzer.provenance import LEDGER
 
         LEDGER.configure(max_runs=cfg.get_int("observability.ledger.runs"))
+        # incremental rebalancing lane (optimizer.incremental.*): in-place
+        # model deltas + goal-scoped re-solve (docs/RESILIENCE.md)
+        incremental_config = IncrementalConfig.from_config(cfg)
     executor = Executor(
         SimulatorClusterDriver(sim, latency_polls=2),
         config=executor_config, load_monitor=monitor,
@@ -154,7 +161,8 @@ def build_simulated_service(
     facade = CruiseControl(
         monitor, executor, optimizer=optimizer,
         config=FacadeConfig(
-            default_requirements=ModelCompletenessRequirements(1, 0.5, False)
+            default_requirements=ModelCompletenessRequirements(1, 0.5, False),
+            incremental=incremental_config,
         ),
     )
     acc = AsyncCruiseControl(facade)

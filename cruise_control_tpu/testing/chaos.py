@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from cruise_control_tpu.analyzer.proposals import ExecutionProposal
+from cruise_control_tpu.common.resources import BrokerState
 from cruise_control_tpu.executor.driver import SimulatorClusterDriver
 from cruise_control_tpu.executor.executor import Executor, ExecutorConfig
 from cruise_control_tpu.executor.task import ExecutionTask, TaskType
@@ -48,8 +49,8 @@ from cruise_control_tpu.executor.validation import TopologyFingerprint, Topology
 from cruise_control_tpu.monitor.metadata import MetadataClient
 
 ACTIONS = (
-    "kill_broker", "restore_broker", "delete_topic", "add_partitions",
-    "spike_load", "bump_generation",
+    "kill_broker", "restore_broker", "revive_broker", "delete_topic",
+    "add_partitions", "spike_load", "bump_generation",
 )
 
 
@@ -74,6 +75,8 @@ class Perturbation:
             sim.kill_broker(self.broker)
         elif self.action == "restore_broker":
             sim.restore_broker(self.broker)
+        elif self.action == "revive_broker":
+            sim.revive_broker(self.broker)
         elif self.action == "delete_topic":
             sim.delete_topic(self.topic)
         elif self.action == "add_partitions":
@@ -167,6 +170,50 @@ class InvariantChecker:
                 self._violate("DISPATCH_TO_DEAD_BROKER", task,
                               f"leader {p.new_leader}")
 
+    def check_dense_masks(self) -> List[Dict]:
+        """The simulator's dense arrays must stay mutually consistent after
+        every perturbation — the same alignment contract build_static_ctx
+        and the incremental delta kernel (analyzer/incremental.py) assume
+        when they derive alive/valid masks from these arrays. Checked after
+        each poll; violations are recorded under DENSE_MASK_INCONSISTENT."""
+        topo = self._sim.fetch_topology()
+        a = np.asarray(topo.assignment)
+        tid = np.asarray(topo.topic_id)
+        pidx = np.asarray(topo.partition_index)
+        state = np.asarray(topo.broker_state)
+        rack = np.asarray(topo.broker_rack)
+        host = np.asarray(topo.broker_host)
+        num_brokers = int(state.shape[0])
+
+        def bad(detail: str) -> None:
+            self.violations.append({
+                "kind": "DENSE_MASK_INCONSISTENT", "detail": detail,
+            })
+
+        if not (a.shape[0] == tid.shape[0] == pidx.shape[0]):
+            bad(f"partition axes diverge: assignment {a.shape[0]}, "
+                f"topic_id {tid.shape[0]}, partition_index {pidx.shape[0]}")
+        if not (rack.shape[0] == host.shape[0] == num_brokers):
+            bad(f"broker axes diverge: state {num_brokers}, "
+                f"rack {rack.shape[0]}, host {host.shape[0]}")
+        if tid.size and (tid.min() < 0 or tid.max() >= len(topo.topic_names)):
+            bad(f"topic_id out of range [0, {len(topo.topic_names)}): "
+                f"[{tid.min()}, {tid.max()}]")
+        if a.size and (a.min() < -1 or a.max() >= num_brokers):
+            bad(f"assignment broker index out of range [-1, {num_brokers}): "
+                f"[{a.min()}, {a.max()}]")
+        if a.size and (a[:, 0] < 0).any():
+            rows = np.nonzero(a[:, 0] < 0)[0][:8]
+            bad(f"leaderless partitions (slot 0 empty): rows {rows.tolist()}")
+        valid_states = {int(s) for s in (
+            BrokerState.ALIVE, BrokerState.NEW, BrokerState.DEMOTED,
+            BrokerState.DEAD,
+        )}
+        unknown = sorted(set(int(s) for s in state) - valid_states)
+        if unknown:
+            bad(f"unknown broker states {unknown}")
+        return self.violations
+
     def check_final(self) -> List[Dict]:
         """Replication factor preserved end-to-end for every partition that
         survived the run (deleted topics are exempt; added partitions have
@@ -206,7 +253,10 @@ class ChaosReplayDriver(SimulatorClusterDriver):
 
     def poll(self) -> None:
         self.polls += 1
-        self._plan.advance(self._sim, self.polls)
+        if self._plan.advance(self._sim, self.polls):
+            # only perturbations can break dense-array alignment, so the
+            # mask audit rides the polls where something actually fired
+            self._checker.check_dense_masks()
         super().poll()
 
     # -- name-keyed addressing -------------------------------------------------

@@ -215,6 +215,15 @@ class SimulatedCluster:
         with self._lock:
             self._state[broker_index] = BrokerState.ALIVE
 
+    def revive_broker(self, broker_index: int) -> None:
+        """A dead broker re-joins as NEW (not ALIVE): its replicas survived
+        on disk but the rebalancer should treat it as a fresh destination —
+        the incremental lane's `broker_revival` delta keys off this
+        transition (analyzer/incremental.py)."""
+        with self._lock:
+            if self._state[broker_index] == BrokerState.DEAD:
+                self._state[broker_index] = BrokerState.NEW
+
     # -- topology perturbations (chaos replay, testing/chaos.py) ---------------
 
     def delete_topic(self, topic: int) -> int:

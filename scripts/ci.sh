@@ -24,6 +24,8 @@
 #   5  provenance digest mismatch at equal parity — decision drift; run
 #      scripts/diff_runs.py on the two runs' ledgers (perf_gate exit 5)
 #   6  perf-gate usage / unreadable input (perf_gate exit 2)
+#   7  incremental-vs-scratch digest mismatch — the delta-updated device
+#      context diverged from the rebuild path (perf_gate exit 6)
 set -u
 cd "$(dirname "$0")/.."
 
@@ -67,6 +69,7 @@ if [ $# -ge 1 ]; then
         1) exit 3 ;;
         4) exit 4 ;;
         5) exit 5 ;;
+        6) exit 7 ;;
         *) exit 6 ;;
     esac
 fi

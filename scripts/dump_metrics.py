@@ -16,6 +16,9 @@ Output (stdout):
      retry/dead-task/dispatch-failure counters (docs/RESILIENCE.md),
   5. the proposal drift/validation picture: trimmed-by-reason counts, the
      generation-skew gauge, and the batch-abort counter,
+  5b. the incremental-rebalancing picture: lane armings, deltas applied by
+     kind, goals skipped by the sensitivity map, the re-proposal timer, and
+     fallback-to-full counts by reason (docs/RESILIENCE.md),
   6. the perf observatory: device telemetry (per-bucket program flops/bytes
      from XLA cost analysis, device-memory watermark, host<->device transfer
      totals) and the top time-series movers from /timeseries
@@ -191,6 +194,57 @@ def _drift_section(text: str) -> None:
     for reason, count in sorted(trimmed.items(), key=lambda kv: -kv[1]):
         if count:
             print(f"   trimmed[{reason}]".ljust(55) + f"{count:>8}")
+
+
+def _incremental_section(text: str) -> None:
+    """Incremental-rebalancing picture (docs/RESILIENCE.md): how often the
+    lane proposed in place vs fell back to a full re-solve, what deltas it
+    absorbed, and what the re-proposal latency looks like."""
+    meters = {}
+    skipped = None
+    timer = None
+    for line in text.splitlines():
+        if line.startswith("#") or "{" not in line:
+            continue
+        name, rest = line.split("{", 1)
+        labels_raw, value = rest.rsplit("} ", 1)
+        labels = _parse_labels(labels_raw)
+        sensor = labels.get("sensor", "")
+        if not sensor.startswith("Incremental."):
+            continue
+        if name == "cruise_control_meter_total":
+            meters[sensor] = int(float(value))
+        elif name == "cruise_control_gauge" and sensor == "Incremental.goals-skipped":
+            skipped = int(float(value))
+        elif name in ("cruise_control_latency_seconds_sum",
+                      "cruise_control_latency_seconds_count",
+                      "cruise_control_timer_seconds_sum",
+                      "cruise_control_timer_seconds_count"):
+            timer = timer or {"count": 0, "sum": 0.0}
+            if name.endswith("_sum"):
+                timer["sum"] = float(value)
+            else:
+                timer["count"] = int(float(value))
+    print("\n== incremental rebalancing (in-place deltas) ==")
+    if not meters and skipped is None and timer is None:
+        print("   (no incremental sensors exported — lane never armed)")
+        return
+    armed = meters.get("Incremental.lane-armed", 0)
+    fallbacks = meters.get("Incremental.fallback-to-full", 0)
+    print(f"   lane armings                                         {armed:>8}")
+    if skipped is not None:
+        print(f"   goals skipped by sensitivity (last re-solve)         {skipped:>8}")
+    if timer and timer["count"]:
+        mean = timer["sum"] / timer["count"]
+        print(f"   re-proposals: {timer['count']} in {_fmt_s(timer['sum'])}"
+              f" (mean {_fmt_s(mean)})")
+    for sensor, count in sorted(meters.items(), key=lambda kv: -kv[1]):
+        if not count or sensor == "Incremental.lane-armed":
+            continue
+        marker = "!!" if sensor == "Incremental.fallback-to-full" and (
+            fallbacks > armed // 2
+        ) else "  "
+        print(f"{marker} {sensor:<52} {count:>8}")
 
 
 def _fmt_bytes(v: float) -> str:
@@ -381,6 +435,7 @@ def main() -> int:
     _sensor_table(metrics_text)
     _resilience_section(metrics_text)
     _drift_section(metrics_text)
+    _incremental_section(metrics_text)
     _perf_section(metrics_text)
     _timeseries_movers(base)
     _provenance_section(base, metrics_text)

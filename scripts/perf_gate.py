@@ -39,6 +39,17 @@ while looking equally good — silent decision drift, not a perf regression.
 It gets its own exit path (5) so pipelines can route it to
 scripts/diff_runs.py instead of a perf triage.
 
+Incremental-lane check (PR 20): when the candidate record carries an
+`incrementalDigestOk` flag (bench.py emits it after timing a seeded
+perturbation through the incremental lane, analyzer/incremental.py), the
+flag must be True — False means an in-place delta re-solve and a
+from-scratch solve of the SAME goal subset on the SAME perturbed model
+produced different decisions, i.e. the scatter-updated device context has
+diverged from the rebuild path. That is a correctness break in the
+incremental kernel, not a perf regression, so it gets its own exit code
+(6). The `incrementalReproposalS` wall rides the ordinary --tol-wall check
+against the baseline when both records carry it.
+
 Exit codes (stable; CI scripts may match on them):
   0  pass
   1  regression (any tolerance exceeded or parity flip)
@@ -46,6 +57,9 @@ Exit codes (stable; CI scripts may match on them):
   4  platform mismatch between candidate and baseline fingerprints
   5  provenance digest mismatch at equal parity (decision drift; run
      scripts/diff_runs.py on the two runs' ledgers)
+  6  incremental-vs-scratch digest mismatch (candidate reports
+     incrementalDigestOk=false: the delta-updated context diverged from
+     the rebuild path on the re-solved goal subset)
 
 Usage:
   python scripts/perf_gate.py BASELINE_DETAIL.json CANDIDATE_DETAIL.json \
@@ -66,6 +80,7 @@ EXIT_REGRESSION = 1
 EXIT_ERROR = 2
 EXIT_PLATFORM_MISMATCH = 4
 EXIT_DIGEST_MISMATCH = 5
+EXIT_INCREMENTAL_DIGEST = 6
 
 _CONFIG_RE = re.compile(r"BASELINE config (\d+)")
 
@@ -123,6 +138,9 @@ class Gate:
         #: from `failed` so it maps to its own exit code when it is the ONLY
         #: finding (a perf regression still exits 1 and dominates)
         self.digest_mismatch = False
+        #: incremental-lane divergence (incrementalDigestOk=false): a
+        #: correctness break in the delta kernel, own exit code (6)
+        self.incremental_mismatch = False
 
     def check(self, cid: str, name: str, ok: bool, detail: str) -> None:
         self.checks.append(
@@ -131,6 +149,8 @@ class Gate:
         if not ok:
             if name == "provenanceDigest":
                 self.digest_mismatch = True
+            elif name == "incrementalDigestOk":
+                self.incremental_mismatch = True
             else:
                 self.failed = True
 
@@ -187,6 +207,22 @@ class Gate:
             self.check(
                 cid, "parityOk", c.get("parityOk") is True,
                 f"parityOk {c.get('parityOk')} vs baseline True",
+            )
+        ci = c.get("incrementalDigestOk")
+        if ci is not None:
+            self.check(
+                cid, "incrementalDigestOk", ci is True,
+                f"incremental-vs-scratch digest ok: {ci} (delta-updated "
+                "context must reproduce the rebuild path's decisions)",
+            )
+        bi_s, ci_s = b.get("incrementalReproposalS"), c.get("incrementalReproposalS")
+        if walls and isinstance(bi_s, (int, float)) and isinstance(ci_s, (int, float)) \
+                and bi_s > 0 and ci_s > 0:
+            limit_i = bi_s * (1.0 + a.tol_wall)
+            self.check(
+                cid, "incrementalWall", ci_s <= limit_i,
+                f"incremental re-proposal {ci_s:.3f}s vs baseline {bi_s:.3f}s "
+                f"(limit {limit_i:.3f}s, tol {a.tol_wall:+.0%})",
             )
         bd, cd = b.get("provenanceDigest"), c.get("provenanceDigest")
         if (
@@ -269,7 +305,9 @@ def main(argv=None) -> int:
         print(json.dumps(
             {"checks": gate.checks,
              "digestMismatch": gate.digest_mismatch,
-             "pass": not gate.failed and not gate.digest_mismatch and not (
+             "incrementalMismatch": gate.incremental_mismatch,
+             "pass": not gate.failed and not gate.digest_mismatch
+             and not gate.incremental_mismatch and not (
                  platform_mismatch and not args.allow_platform_mismatch)},
             indent=1,
         ))
@@ -284,7 +322,9 @@ def main(argv=None) -> int:
         return EXIT_PLATFORM_MISMATCH
     if gate.failed:
         return EXIT_REGRESSION
-    return EXIT_DIGEST_MISMATCH if gate.digest_mismatch else EXIT_PASS
+    if gate.digest_mismatch:
+        return EXIT_DIGEST_MISMATCH
+    return EXIT_INCREMENTAL_DIGEST if gate.incremental_mismatch else EXIT_PASS
 
 
 if __name__ == "__main__":

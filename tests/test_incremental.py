@@ -1,0 +1,596 @@
+"""Incremental rebalancing lane (analyzer/incremental.py, ISSUE 20).
+
+Fast lane: compile-free unit coverage of the delta vocabulary
+(derive_deltas shape/structural fallbacks, kind classification, exact f32
+row payloads), the goal-sensitivity map, the fixed-shape batch packing, the
+lane's typed fallback outcomes, and the `optimizer.incremental.*` config
+plumbing. Everything here is solver-free so the tier-1 wall budget is
+untouched — the module-scoped `solved` fixture below only instantiates
+when a --runslow test first requests it.
+
+Slow lane (--runslow): the digest-identity acceptance contract (the
+full-stack compile is shared with tests/test_optimizer.py — same seed-7
+model, same OptimizerSettings(chunk_rounds=2): the module-level program
+cache keys by (goal_names, dims, settings, mesh), so the chunked machine
+is compiled once per pytest process regardless of which file reaches it
+first) and the incremental chaos matrix — lane proposals
+replayed through the PR-5 chaos harness while perturbation streams land
+mid-batch (broker death/revival, load spikes, partition adds, generation
+churn), asserting zero invariant violations, dense-mask consistency after
+every perturbation, and the typed fallback path (topic delete, delta
+overflow) exercised at least once.
+"""
+
+import numpy as np
+import pytest
+
+from cruise_control_tpu.analyzer import incremental as inc
+from cruise_control_tpu.analyzer.context import OptimizationOptions
+from cruise_control_tpu.analyzer.goals import HARD_GOAL_NAMES, goals_by_priority
+from cruise_control_tpu.analyzer.optimizer import GoalOptimizer, OptimizerSettings
+from cruise_control_tpu.common.resources import BrokerState
+from cruise_control_tpu.common.sensors import REGISTRY
+from cruise_control_tpu.models import generators
+
+#: the tests_optimizer TestFullStack cluster — SAME generator parameters so
+#: the chunked-machine program cache key (goal_names, dims, settings, mesh)
+#: is shared with test_optimizer.test_chunked_machine_equals_fused_stack
+_PROP = generators.ClusterProperty(
+    num_racks=4, num_brokers=12, num_topics=20,
+    mean_partitions_per_topic=8.0, replication_factor=2,
+    load_distribution="exponential", mean_utilization=0.4,
+)
+
+
+def _small_model():
+    return generators.random_cluster(
+        seed=11,
+        prop=generators.ClusterProperty(
+            num_racks=2, num_brokers=6, num_topics=5,
+            mean_partitions_per_topic=4.0, replication_factor=2,
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def solved():
+    """One full-stack solve on the seed-7 cluster; every digest/chaos case
+    re-arms its own lane from this prep-cache entry."""
+    model = generators.random_cluster(seed=7, prop=_PROP)
+    opt = GoalOptimizer(settings=OptimizerSettings(chunk_rounds=2))
+    options = OptimizationOptions()
+    full = opt.optimizations(model, options=options)
+    names = tuple(g.name for g in full.goal_results)
+    return model, opt, options, full, names
+
+
+def _armed_lane(solved, config=None):
+    model, opt, options, _full, names = solved
+    lane = inc.IncrementalLane(opt, config or inc.IncrementalConfig())
+    if not lane.arm(model, options, names, generation=1):
+        # the 2-entry prep cache evicted the base model (scratch solves on
+        # perturbed models in earlier tests): re-prepare, warm program
+        opt.optimizations(model, options=options)
+        assert lane.arm(model, options, names, generation=1)
+    return lane
+
+
+# -- derive_deltas: the typed diff ---------------------------------------------
+
+
+class TestDeriveDeltas:
+    def test_identical_models_no_deltas(self):
+        m = _small_model()
+        deltas, reason = inc.derive_deltas(m, m)
+        assert deltas == [] and reason is None
+
+    def test_rf_growth_is_shape_fallback(self):
+        m = _small_model()
+        a = np.asarray(m.assignment)
+        wider = np.concatenate([a, np.full((a.shape[0], 1), -1, a.dtype)], axis=1)
+        deltas, reason = inc.derive_deltas(m, m._replace(assignment=wider))
+        assert deltas == [] and reason == inc.FALLBACK_SHAPE_RF
+
+    def test_broker_count_change_is_shape_fallback(self):
+        m = _small_model()
+        shrunk = m._replace(
+            broker_capacity=np.asarray(m.broker_capacity)[:-1],
+            broker_rack=np.asarray(m.broker_rack)[:-1],
+            broker_host=np.asarray(m.broker_host)[:-1],
+            broker_state=np.asarray(m.broker_state)[:-1],
+        )
+        deltas, reason = inc.derive_deltas(m, shrunk)
+        assert deltas == [] and reason == inc.FALLBACK_SHAPE_BROKERS
+
+    def test_capacity_or_topology_edit_is_structural(self):
+        m = _small_model()
+        cap = np.asarray(m.broker_capacity).copy()
+        cap[0, 0] *= 2
+        _, reason = inc.derive_deltas(m, m._replace(broker_capacity=cap))
+        assert reason == inc.FALLBACK_STRUCTURAL
+        rack = np.asarray(m.broker_rack).copy()
+        rack[1] = (rack[1] + 1) % 2
+        _, reason = inc.derive_deltas(m, m._replace(broker_rack=rack))
+        assert reason == inc.FALLBACK_STRUCTURAL
+
+    def test_topic_delete_emits_marker_not_rows(self):
+        m = _small_model()
+        k = m.num_partitions - 3
+        gone = m._replace(
+            assignment=np.asarray(m.assignment)[:k],
+            part_load=np.asarray(m.part_load)[:k],
+            topic_id=np.asarray(m.topic_id)[:k],
+        )
+        deltas, reason = inc.derive_deltas(m, gone)
+        assert reason is None
+        assert [d.kind for d in deltas] == [inc.DELTA_TOPIC_DELETE]
+        # the marker is unscopeable by design: forces the full fallback
+        assert inc.affected_goals(deltas, ["RackAwareGoal"]) is None
+
+    def test_row_shift_is_structural_shift(self):
+        m = _small_model()
+        shifted = m._replace(topic_id=np.roll(np.asarray(m.topic_id), 1))
+        deltas, reason = inc.derive_deltas(m, shifted)
+        assert deltas == [] and reason == inc.FALLBACK_STRUCTURAL_SHIFT
+
+    def test_state_transitions_classify_by_direction(self):
+        m = _small_model()
+        st_old = np.asarray(m.broker_state).copy()
+        st_old[0] = BrokerState.DEAD
+        old = m._replace(broker_state=st_old)
+        st_new = st_old.copy()
+        st_new[0] = BrokerState.NEW  # DEAD -> NEW: revival
+        st_new[1] = BrokerState.DEAD  # ALIVE -> DEAD: death
+        st_new[2] = BrokerState.DEMOTED  # ALIVE -> DEMOTED: state
+        deltas, reason = inc.derive_deltas(old, old._replace(broker_state=st_new))
+        assert reason is None
+        by_broker = {d.broker: d for d in deltas}
+        assert by_broker[0].kind == inc.DELTA_BROKER_REVIVAL
+        assert by_broker[1].kind == inc.DELTA_BROKER_DEATH
+        assert by_broker[2].kind == inc.DELTA_BROKER_STATE
+        assert all(d.state == st_new[d.broker] for d in deltas)
+
+    def test_load_spike_carries_exact_rows(self):
+        m = _small_model()
+        pl = np.asarray(m.part_load).copy()
+        pl[2] *= np.float32(4.0)
+        pl[5] *= np.float32(0.5)
+        deltas, reason = inc.derive_deltas(m, m._replace(part_load=pl))
+        assert reason is None
+        assert [(d.kind, d.row) for d in deltas] == [
+            (inc.DELTA_LOAD_SPIKE, 2), (inc.DELTA_LOAD_SPIKE, 5)
+        ]
+        # replacement rows, not multipliers: bitwise-equal to the fresh model
+        for d in deltas:
+            assert np.array_equal(np.asarray(d.load), pl[d.row])
+
+    def test_partition_add_appends_rows(self):
+        m = _small_model()
+        p = m.num_partitions
+        a = np.asarray(m.assignment)
+        added = m._replace(
+            assignment=np.concatenate([a, np.array([[0, 1], [2, 3]], a.dtype)]),
+            part_load=np.concatenate(
+                [np.asarray(m.part_load),
+                 np.full((2, np.asarray(m.part_load).shape[1]), 0.03, np.float32)]
+            ),
+            topic_id=np.concatenate(
+                [np.asarray(m.topic_id), np.array([4, 4], np.int32)]
+            ),
+        )
+        deltas, reason = inc.derive_deltas(m, added)
+        assert reason is None
+        assert [(d.kind, d.row, d.topic) for d in deltas] == [
+            (inc.DELTA_PART_ADD, p, 4), (inc.DELTA_PART_ADD, p + 1, 4)
+        ]
+        assert all(np.allclose(np.asarray(d.load), 0.03) for d in deltas)
+
+
+# -- sensitivity ---------------------------------------------------------------
+
+
+class TestSensitivity:
+    def _armed(self):
+        return tuple(g.name for g in goals_by_priority())
+
+    def test_load_spike_scopes_to_load_goals(self):
+        armed = self._armed()
+        affected = inc.affected_goals(
+            [inc.ModelDelta(kind=inc.DELTA_LOAD_SPIKE, row=0)], armed
+        )
+        assert affected == tuple(n for n in armed if n in inc._LOAD_GOALS)
+        assert not set(affected) & inc._COUNT_GOALS
+
+    def test_part_add_scopes_to_count_goals(self):
+        armed = self._armed()
+        affected = inc.affected_goals(
+            [inc.ModelDelta(kind=inc.DELTA_PART_ADD, row=0, topic=0)], armed
+        )
+        assert affected == tuple(n for n in armed if n in inc._COUNT_GOALS)
+
+    def test_broker_death_affects_every_goal(self):
+        armed = self._armed()
+        affected = inc.affected_goals(
+            [inc.ModelDelta(kind=inc.DELTA_BROKER_DEATH, broker=0, state=3)],
+            armed,
+        )
+        assert affected == armed
+
+    def test_revival_excludes_hard_goals(self):
+        armed = self._armed()
+        affected = inc.affected_goals(
+            [inc.ModelDelta(kind=inc.DELTA_BROKER_REVIVAL, broker=0, state=1)],
+            armed,
+        )
+        assert set(affected) == set(armed) - set(HARD_GOAL_NAMES)
+
+    def test_union_preserves_armed_order(self):
+        armed = self._armed()
+        affected = inc.affected_goals(
+            [
+                inc.ModelDelta(kind=inc.DELTA_LOAD_SPIKE, row=0),
+                inc.ModelDelta(kind=inc.DELTA_PART_ADD, row=1, topic=0),
+            ],
+            armed,
+        )
+        assert affected == tuple(
+            n for n in armed if n in (inc._LOAD_GOALS | inc._COUNT_GOALS)
+        )
+
+    def test_topic_delete_is_unscopeable(self):
+        assert (
+            inc.affected_goals(
+                [inc.ModelDelta(kind=inc.DELTA_TOPIC_DELETE)], self._armed()
+            )
+            is None
+        )
+
+
+# -- batch packing -------------------------------------------------------------
+
+
+def test_delta_batch_pads_to_fixed_shape():
+    deltas = [
+        inc.ModelDelta(kind=inc.DELTA_BROKER_DEATH, broker=3, state=3),
+        inc.ModelDelta(
+            kind=inc.DELTA_LOAD_SPIKE, row=7, load=np.full(4, 2.0, np.float32)
+        ),
+    ]
+    batch = inc.build_delta_batch(deltas, max_deltas=8, num_metrics=4)
+    assert batch.kind.shape == (8,) and batch.load.shape == (8, 4)
+    kinds = np.asarray(batch.kind)
+    assert kinds[0] == inc.KIND_STATE and kinds[1] == inc.KIND_LOAD
+    assert (kinds[2:] == inc.KIND_NOOP).all()
+    assert np.asarray(batch.broker)[0] == 3
+    assert np.asarray(batch.row)[1] == 7
+    assert np.allclose(np.asarray(batch.load)[1], 2.0)
+
+
+# -- lane fallbacks (compile-free) ---------------------------------------------
+
+
+class TestLaneFallbacks:
+    def test_disabled_lane_never_arms_and_falls_back(self):
+        lane = inc.IncrementalLane(
+            GoalOptimizer(), inc.IncrementalConfig(enabled=False)
+        )
+        m = _small_model()
+        assert lane.arm(m, OptimizationOptions(), ["RackAwareGoal"]) is False
+        out = lane.propose(m)
+        assert not out.ok and out.fallback_reason == inc.FALLBACK_DISABLED
+
+    def test_unarmed_lane_is_typed_fallback(self):
+        before = REGISTRY.meter(
+            f"Incremental.fallback-to-full.{inc.FALLBACK_NOT_ARMED}"
+        ).count
+        lane = inc.IncrementalLane(GoalOptimizer())
+        out = lane.propose(_small_model())
+        assert not out.ok and out.fallback_reason == inc.FALLBACK_NOT_ARMED
+        assert (
+            REGISTRY.meter(
+                f"Incremental.fallback-to-full.{inc.FALLBACK_NOT_ARMED}"
+            ).count
+            == before + 1
+        )
+        state = lane.state()
+        assert state["armed"] is False
+        assert state["lastOutcome"]["fallbackReason"] == inc.FALLBACK_NOT_ARMED
+
+    def test_arm_without_prepared_entry_returns_false(self):
+        # no solve ever ran on this optimizer: the prep-cache seam is empty
+        lane = inc.IncrementalLane(GoalOptimizer())
+        assert lane.arm(_small_model(), OptimizationOptions(), []) is False
+
+
+# -- config plumbing (PR-4 pattern) --------------------------------------------
+
+
+def test_incremental_config_keys_parse_and_map():
+    from cruise_control_tpu.config.configdef import ConfigException
+    from cruise_control_tpu.config.cruise_config import CruiseControlConfig
+
+    cfg = CruiseControlConfig({
+        "optimizer.incremental.enabled": "false",
+        "optimizer.incremental.max.deltas": "17",
+        "optimizer.incremental.fallback.full": "false",
+    })
+    ic = inc.IncrementalConfig.from_config(cfg)
+    assert ic.enabled is False and ic.max_deltas == 17 and ic.fallback_full is False
+    dflt = CruiseControlConfig({})
+    assert dflt.get_boolean("optimizer.incremental.enabled") is True
+    assert dflt.get_int("optimizer.incremental.max.deltas") == 64
+    assert dflt.get_boolean("optimizer.incremental.fallback.full") is True
+    with pytest.raises(ConfigException):
+        CruiseControlConfig({"optimizer.incremental.max.deltas": "0"})
+
+
+def test_incremental_keys_reach_service_wiring(tmp_path):
+    """main --config plumbing, matching the PR-4 resilience pattern."""
+    props = tmp_path / "cc.properties"
+    props.write_text(
+        "optimizer.incremental.enabled=true\n"
+        "optimizer.incremental.max.deltas=7\n"
+        "optimizer.incremental.fallback.full=false\n"
+    )
+    from cruise_control_tpu.main import build_simulated_service
+
+    _, parts = build_simulated_service(
+        num_brokers=4, num_racks=2, num_topics=3, config_path=str(props)
+    )
+    lane_cfg = parts["facade"]._incremental.config
+    assert lane_cfg.enabled is True
+    assert lane_cfg.max_deltas == 7
+    assert lane_cfg.fallback_full is False
+
+
+# -- the digest-identity contract (slow lane, shared compile) ------------------
+
+
+@pytest.mark.slow
+class TestDigestIdentity:
+    """ISSUE-20 acceptance: a goal-scoped incremental re-solve must be
+    provenance-digest-equal to a from-scratch solve of the same subset on
+    the same perturbed model, with ZERO moves on the goals the sensitivity
+    map marks unaffected."""
+
+    def test_load_spike_digest_equal_and_unaffected_goals_untouched(self, solved):
+        model, opt, _options, full, names = solved
+        lane = _armed_lane(solved)
+        pl = np.asarray(model.part_load).copy()
+        pl[np.asarray(model.topic_id) == 3] *= np.float32(4.0)
+        spiked = model._replace(part_load=pl)
+
+        out = lane.propose(spiked, generation=2)
+        assert out.ok, out.fallback_reason
+        assert set(out.affected) <= inc._LOAD_GOALS
+        assert out.goals_skipped == len(names) - len(out.affected) > 0
+
+        scratch = opt.optimizations(
+            spiked, goal_names=list(out.affected), options=OptimizationOptions()
+        )
+        assert out.result.provenance.digest() == scratch.provenance.digest()
+        unaffected = [n for n in names if n not in out.affected]
+        assert out.result.provenance.digest(goals=unaffected)["moves"] == 0
+
+        # stale monitor generation after the lane advanced: typed fallback
+        # (the chronologically-armed generation is now 2)
+        stale = lane.propose(spiked, generation=1)
+        assert not stale.ok
+        assert stale.fallback_reason == inc.FALLBACK_STALE_GENERATION
+
+    def test_broker_death_stays_in_lane_unscoped(self, solved):
+        model, opt, _options, _full, names = solved
+        lane = _armed_lane(solved)
+        st = np.asarray(model.broker_state).copy()
+        st[5] = BrokerState.DEAD
+        dead = model._replace(broker_state=st)
+
+        out = lane.propose(dead, generation=2)
+        assert out.ok, out.fallback_reason
+        assert out.affected == names and out.goals_skipped == 0
+        scratch = opt.optimizations(
+            dead, goal_names=list(names), options=OptimizationOptions()
+        )
+        assert out.result.provenance.digest() == scratch.provenance.digest()
+        # the evacuation is real: replicas moved off the dead broker
+        final = np.asarray(out.result.final_assignment)
+        assert not (final == 5).any()
+
+
+# -- the incremental chaos matrix (slow lane) ----------------------------------
+
+
+@pytest.mark.slow
+class TestIncrementalChaosMatrix:
+    """Lane proposals replayed through the PR-5 chaos harness while
+    perturbation streams land mid-batch: zero invariant violations, dense
+    masks consistent after every perturbation, fallback typed when the
+    stream is inexpressible. Slow lane: each scenario runs the warm machine
+    once plus a multi-poll executor replay (tier-1 wall discipline)."""
+
+    def _execute(self, sim, plan, proposals):
+        from cruise_control_tpu.executor.validation import TopologyFingerprint
+        from cruise_control_tpu.testing.chaos import ChaosHarness
+
+        h = ChaosHarness(sim, plan)
+        generation = h._generation()
+        topo = h.metadata.refresh_metadata(force=True)
+        summary = h.executor.execute_proposals(
+            proposals, generation=generation,
+            fingerprint=TopologyFingerprint.from_topology(topo),
+        )
+        h.checker.check_final()
+        assert h.checker.violations == []
+        by = summary["byState"]
+        assert by["PENDING"] == by["IN_PROGRESS"] == by["ABORTING"] == 0
+        assert h.executor.state == "NO_TASK_IN_PROGRESS"
+        return h, summary
+
+    def _sim(self, model):
+        from cruise_control_tpu.testing.simulator import SimulatedCluster
+
+        return SimulatedCluster(model)
+
+    def test_death_evacuation_rides_mid_batch_spike(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        sim.kill_broker(3)
+        st = np.asarray(model.broker_state).copy()
+        st[3] = BrokerState.DEAD
+        out = lane.propose(model._replace(broker_state=st), generation=2)
+        assert out.ok and out.affected == names
+        assert out.result.proposals, "a broker death must evacuate replicas"
+        plan = ChaosPlan([
+            Perturbation(at_poll=2, action="spike_load", topic=0, factor=8.0),
+            Perturbation(at_poll=4, action="bump_generation"),
+        ])
+        h, summary = self._execute(sim, plan, out.result.proposals)
+        assert summary["numTotalMovements"] > 0
+        assert plan.exhausted
+
+    def test_mid_batch_revival_keeps_masks_consistent(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, _names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        sim.kill_broker(2)
+        st = np.asarray(model.broker_state).copy()
+        st[2] = BrokerState.DEAD
+        out = lane.propose(model._replace(broker_state=st), generation=2)
+        assert out.ok and out.result.proposals
+        plan = ChaosPlan([
+            Perturbation(at_poll=3, action="revive_broker", broker=2),
+        ])
+        h, _summary = self._execute(sim, plan, out.result.proposals)
+        assert plan.exhausted
+        # the revival fired mid-batch and the dense-mask audit ran clean;
+        # the broker is NEW now, not ALIVE (replicas survived on disk)
+        topo = sim.fetch_topology()
+        assert topo.broker_state[2] == BrokerState.NEW
+        assert h.checker.check_dense_masks() == []
+
+    def test_scoped_spike_survives_mid_batch_death(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        pl = np.asarray(model.part_load).copy()
+        pl[np.asarray(model.topic_id) == 1] *= np.float32(6.0)
+        out = lane.propose(model._replace(part_load=pl), generation=2)
+        assert out.ok
+        assert out.goals_skipped == len(names) - len(out.affected) > 0
+        plan = ChaosPlan([
+            Perturbation(at_poll=3, action="kill_broker", broker=5),
+        ])
+        self._execute(sim, plan, out.result.proposals)
+
+    def test_sequential_stream_death_then_revival(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        st = np.asarray(model.broker_state).copy()
+        st[1] = BrokerState.DEAD
+        killed = model._replace(broker_state=st)
+        first = lane.propose(killed, generation=2)
+        assert first.ok
+        # the lane re-armed on the perturbed model: the next delta stream
+        # diffs against IT, so the revival arrives as one typed delta
+        st2 = st.copy()
+        st2[1] = BrokerState.NEW
+        second = lane.propose(killed._replace(broker_state=st2), generation=3)
+        assert second.ok
+        assert set(second.affected) == set(names) - set(HARD_GOAL_NAMES)
+        sim.kill_broker(1)
+        sim.revive_broker(1)
+        plan = ChaosPlan([
+            Perturbation(at_poll=2, action="spike_load", topic=2, factor=4.0),
+        ])
+        self._execute(sim, plan, second.result.proposals)
+
+    def test_partition_add_stream(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, _names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        sim.add_partitions(2, 2)
+        topo = sim.fetch_topology()
+        pl = np.asarray(model.part_load)
+        grown = model._replace(
+            assignment=np.asarray(topo.assignment),
+            topic_id=np.asarray(topo.topic_id),
+            part_load=np.concatenate(
+                [pl, np.full((2, pl.shape[1]), 0.02, np.float32)]
+            ),
+        )
+        out = lane.propose(grown, generation=2)
+        headroom = lane._armed.dims.num_partitions if out.ok else 0
+        if not out.ok:
+            # the shape bucket had no pad rows left: that is the typed
+            # fallback contract, not a failure
+            assert out.fallback_reason == inc.FALLBACK_SHAPE_BUCKET
+            return
+        assert headroom >= grown.num_partitions
+        assert set(out.affected) <= inc._COUNT_GOALS
+        plan = ChaosPlan([
+            Perturbation(at_poll=2, action="spike_load", topic=0, factor=4.0),
+        ])
+        self._execute(sim, plan, out.result.proposals)
+
+    def test_demotion_churn_with_mid_batch_death_and_restore(self, solved):
+        from cruise_control_tpu.testing.chaos import ChaosPlan, Perturbation
+
+        model, _opt, _options, _full, names = solved
+        lane = _armed_lane(solved)
+        sim = self._sim(model)
+        st = np.asarray(model.broker_state).copy()
+        st[4] = BrokerState.DEMOTED
+        out = lane.propose(model._replace(broker_state=st), generation=2)
+        assert out.ok and out.affected == names
+        plan = ChaosPlan([
+            Perturbation(at_poll=2, action="kill_broker", broker=7),
+            Perturbation(at_poll=6, action="restore_broker", broker=7),
+        ])
+        self._execute(sim, plan, out.result.proposals)
+
+    # -- fallback paths under live streams -------------------------------------
+
+    def test_topic_delete_stream_falls_back(self, solved):
+        model, _opt, _options, _full, _names = solved
+        lane = _armed_lane(solved)
+        k = model.num_partitions - 4
+        gone = model._replace(
+            assignment=np.asarray(model.assignment)[:k],
+            part_load=np.asarray(model.part_load)[:k],
+            topic_id=np.asarray(model.topic_id)[:k],
+        )
+        before = REGISTRY.meter(
+            f"Incremental.fallback-to-full.{inc.FALLBACK_SENSITIVITY_ALL}"
+        ).count
+        out = lane.propose(gone, generation=2)
+        assert not out.ok
+        assert out.fallback_reason == inc.FALLBACK_SENSITIVITY_ALL
+        assert [d.kind for d in out.deltas] == [inc.DELTA_TOPIC_DELETE]
+        assert (
+            REGISTRY.meter(
+                f"Incremental.fallback-to-full.{inc.FALLBACK_SENSITIVITY_ALL}"
+            ).count
+            == before + 1
+        )
+
+    def test_delta_overflow_falls_back(self, solved):
+        model, _opt, _options, _full, _names = solved
+        lane = _armed_lane(solved, inc.IncrementalConfig(max_deltas=4))
+        pl = np.asarray(model.part_load).copy()
+        pl[:10] *= np.float32(3.0)  # ten spiked rows > max_deltas=4
+        out = lane.propose(model._replace(part_load=pl), generation=2)
+        assert not out.ok
+        assert out.fallback_reason == inc.FALLBACK_TOO_MANY_DELTAS
+        assert len(out.deltas) == 10
